@@ -1,0 +1,2 @@
+"""Tensor kernels: the reference's per-(pod,node) Go predicates/priorities
+re-expressed as batched XLA computations over interned class tables."""

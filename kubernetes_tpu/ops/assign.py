@@ -1,0 +1,152 @@
+"""Batched assignment: the whole scheduling cycle as one lax.scan on device.
+
+The reference schedules one pod per `scheduleOne` call (scheduler.go:596-763):
+snapshot → filter over nodes (16 goroutines) → score → selectHost → assume.
+Each pod's placement updates the cache before the next pod is considered —
+sequential *semantics* are load-bearing (two pods landing on one node must see
+each other's resource usage and affinity counts).
+
+Here the entire pending batch is scheduled in ONE device dispatch: a lax.scan
+over pods in queue order (priority desc, creation asc — the activeQ comparator,
+internal/queue/scheduling_queue.go:119-138 + util.GetPodPriority). The scan
+carry is the assume-cache state: per-node used resources, port bitsets, and the
+affinity/spread count tables. Per step: O(N) rows of dynamic checks + gathers
+into the precomputed static [SC, N] lattice. This preserves the reference's
+sequential assume semantics exactly while amortizing all O(SC·N·…) work outside
+the loop.
+
+Deviation (documented in docs/PARITY.md): ties in the max score pick the
+lowest node index (deterministic) instead of the reference's reservoir-random
+selectHost (generic_scheduler.go:290-311).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..state.arrays import Array, ClusterTables, PodArrays
+from .fit import fit_row, resource_scores_row
+from .interpod import affinity_rows, domain_of_term, soft_affinity_row
+from .lattice import CycleArrays
+from .ports import port_conflict_row
+from .topospread import spread_row
+
+
+class AssignState(NamedTuple):
+    used: Array  # [N, R] i32
+    ppa: Array   # [N, PWp] u32 — (proto,port) pairs in use (any IP)
+    ppw: Array   # [N, PWp] u32 — wildcard-IP pairs in use
+    ppt: Array   # [N, PWt] u32 — exact triples in use
+    CNT: Array   # [S, N] i32 — per-node term match counts
+    HOLD: Array  # [S, N] i32 — per-node anti-term holders
+
+
+class AssignResult(NamedTuple):
+    node: Array       # [P] i32 — chosen node index, -1 unschedulable
+    feasible: Array   # [P] bool
+    state: AssignState
+
+
+def queue_order(pods: PodArrays) -> Array:
+    """activeQ pop order: valid first, then priority desc, then creation asc
+    (scheduling_queue.go activeQComp → podutil.GetPodPriority + timestamp)."""
+    return jnp.lexsort((pods.creation, -pods.priority, ~pods.valid))
+
+
+def assign_batch(
+    tables: ClusterTables,
+    cyc: CycleArrays,
+    pods: PodArrays,
+    init: AssignState,
+) -> AssignResult:
+    nodes = tables.nodes
+    classes = tables.classes
+    terms = tables.terms
+    S = cyc.TM.shape[0]
+    D = cyc.ELD.shape[2] - 1
+
+    order = queue_order(pods)
+
+    def step(state: AssignState, idx):
+        c = pods.cls[idx]
+        p_valid = pods.valid[idx]
+        rid = classes.rid[c]
+        req_vec = tables.reqs.vec[rid]
+
+        # ---- dynamic Filter rows ----
+        fit = fit_row(req_vec, state.used, nodes.alloc, nodes.valid)
+
+        ps = classes.portset[c]
+        psafe = jnp.maximum(ps, 0)
+        conflict = port_conflict_row(
+            tables.portsets.wild_words[psafe],
+            tables.portsets.pair_words[psafe],
+            tables.portsets.trip_words[psafe],
+            state.ppa, state.ppw, state.ppt,
+        )
+        port_ok = (ps < 0) | ~conflict
+
+        aff_ok, anti_ok = affinity_rows(
+            c, classes, terms, cyc.TM, state.CNT, state.HOLD, nodes, D
+        )
+        spread_ok = spread_row(
+            c, classes, terms, cyc.TM, state.CNT, cyc.ELD,
+            cyc.static.node_match[c], nodes, D,
+        )
+
+        nnr = pods.node_name_req[idx]
+        host_ok = (nnr < 0) | (nodes.name_id == nnr)
+
+        mask = (
+            cyc.static.mask[c]
+            & fit & port_ok & aff_ok & anti_ok & spread_ok & host_ok
+        )
+
+        # ---- Score row (weighted sum, all default weights 1;
+        #      generic_scheduler.go:823-832) ----
+        least, balanced = resource_scores_row(req_vec, state.used, nodes.alloc)
+        soft_ip = soft_affinity_row(c, classes, terms, state.CNT, nodes, D)
+        score = cyc.static.score[c] + least + balanced + soft_ip
+        score = jnp.where(mask, score, -jnp.inf)
+
+        choice = jnp.argmax(score)
+        feasible = mask.any() & p_valid
+        node = jnp.where(feasible, choice, -1)
+
+        # ---- assume: commit to carry (cache.AssumePod analog) ----
+        add = jnp.where(feasible, req_vec, 0)
+        used = state.used.at[choice].add(add)
+
+        live_ps = feasible & (ps >= 0)
+        pw = jnp.where(live_ps, tables.portsets.pair_words[psafe], 0)
+        ww = jnp.where(live_ps, tables.portsets.wild_words[psafe], 0)
+        tw = jnp.where(live_ps, tables.portsets.trip_words[psafe], 0)
+        ppa = state.ppa.at[choice].set(state.ppa[choice] | pw)
+        ppw = state.ppw.at[choice].set(state.ppw[choice] | ww)
+        ppt = state.ppt.at[choice].set(state.ppt[choice] | tw)
+
+        # affinity/spread counts: this pod now matches its terms at its node
+        inc = (cyc.TM[:, c] & feasible).astype(jnp.int32)   # [S]
+        CNT = state.CNT.at[:, choice].add(inc)
+        inc_h = (cyc.has_anti[c] & feasible).astype(jnp.int32)
+        HOLD = state.HOLD.at[:, choice].add(inc_h)
+
+        return AssignState(used, ppa, ppw, ppt, CNT, HOLD), (node, feasible)
+
+    final, (nodes_sorted, feas_sorted) = jax.lax.scan(step, init, order)
+
+    P = pods.valid.shape[0]
+    node_out = jnp.full((P,), -1, jnp.int32).at[order].set(nodes_sorted)
+    feas_out = jnp.zeros((P,), bool).at[order].set(feas_sorted)
+    return AssignResult(node=node_out, feasible=feas_out, state=final)
+
+
+def initial_state(tables: ClusterTables, cyc: CycleArrays) -> AssignState:
+    n = tables.nodes
+    return AssignState(
+        used=n.used, ppa=n.port_pair_any, ppw=n.port_pair_wild, ppt=n.port_triple,
+        CNT=cyc.CNT, HOLD=cyc.HOLD,
+    )

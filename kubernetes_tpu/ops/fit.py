@@ -1,0 +1,65 @@
+"""NodeResourcesFit and resource-based scores as tensor ops.
+
+Reference semantics: PodFitsResources (algorithm/predicates/predicates.go:789-845)
+— a pod fits iff for every resource r: request_r ≤ allocatable_r − used_r, with
+zero requests always passing (the zero-request fast path :800-806 falls out of
+the per-resource rule), plus the pod-count check used+1 ≤ allowedPodNumber
+(encoded as resource RES_PODS with request 1).
+
+Scores: least_requested.go / most_requested.go / balanced_resource_allocation.go.
+The reference computes integer (cap−total)*100/cap per resource; we compute in
+float32 (memory capacities exceed int32×100), which can differ from the
+reference by <1 score point — masks stay bit-exact, scores are within ±1.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..state.arrays import Array, NodeArrays, ReqTable
+
+MAX_NODE_SCORE = 100.0  # framework/v1alpha1/interface.go:87
+
+
+def fit_matrix(reqs: ReqTable, nodes: NodeArrays) -> Array:
+    """[SR, N] bool: request-class r fits on node n given current `used`."""
+    free = nodes.alloc - nodes.used  # [N, R]
+    vec = reqs.vec  # [SR, R]
+    ok = (vec[:, None, :] == 0) | (vec[:, None, :] <= free[None, :, :])
+    return ok.all(-1) & nodes.valid[None, :]
+
+
+def fit_row(req_vec: Array, used: Array, alloc: Array, valid: Array) -> Array:
+    """[N] bool for one request vector against live used — the scan inner check."""
+    free = alloc - used
+    ok = (req_vec[None, :] == 0) | (req_vec[None, :] <= free)
+    return ok.all(-1) & valid
+
+
+def _frac(total: Array, cap: Array) -> Array:
+    cap_f = cap.astype(jnp.float32)
+    return jnp.where(cap > 0, total.astype(jnp.float32) / jnp.maximum(cap_f, 1.0), 0.0)
+
+
+def resource_scores_row(req_vec: Array, used: Array, alloc: Array) -> tuple[Array, Array]:
+    """(least_requested [N], balanced_allocation [N]) in 0..100 float32.
+
+    least_requested.go:60-77: per-resource (cap−total)*100/cap clamped at 0,
+    averaged over cpu+memory. balanced_resource_allocation.go:68-102:
+    100 − |cpuFraction−memFraction|*100, 0 if either fraction ≥ 1."""
+    total = used + req_vec[None, :]  # [N, R]
+    cpu_cap, mem_cap = alloc[:, 0], alloc[:, 1]
+    cpu_t, mem_t = total[:, 0], total[:, 1]
+
+    def least(t, cap):
+        s = (cap.astype(jnp.float32) - t.astype(jnp.float32)) * MAX_NODE_SCORE
+        s = s / jnp.maximum(cap.astype(jnp.float32), 1.0)
+        return jnp.where((cap > 0) & (t <= cap), s, 0.0)
+
+    least_score = (least(cpu_t, cpu_cap) + least(mem_t, mem_cap)) / 2.0
+
+    cf, mf = _frac(cpu_t, cpu_cap), _frac(mem_t, mem_cap)
+    balanced = jnp.where(
+        (cf >= 1.0) | (mf >= 1.0), 0.0, MAX_NODE_SCORE - jnp.abs(cf - mf) * MAX_NODE_SCORE
+    )
+    return least_score, balanced

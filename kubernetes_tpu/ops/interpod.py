@@ -1,0 +1,180 @@
+"""Inter-pod affinity/anti-affinity as tensor ops over interned terms.
+
+The reference's approach (predicates.go:1212-1520 + metadata.go:60-112) builds,
+per incoming pod, maps (topoKey, topoValue) → matching existing pods by scanning
+all pods × all terms with 16-way goroutine fan-out. The TPU re-design exploits
+two quotients:
+
+  1. terms are interned (TermTable) — each distinct (selector, namespaces,
+     topologyKey) is evaluated once per cycle, not once per pod;
+  2. matching is factored through label-set classes: TM[S, SC] says "term s
+     matches pod-class c".
+
+Live state is carried as per-NODE counts (CNT_node[S, N]: matching pods of term
+s on node n; HOLD_node[S, N]: holders of anti-term s on node n) and aggregated
+over topology domains on demand by scatter-add — because different consumers
+aggregate differently: inter-pod affinity counts pods on ALL nodes carrying the
+key (metadata.go:407-437 has no node filter), while topology spread counts only
+pods on nodes *eligible* for the incoming pod (metadata.go:145-151). Keeping the
+node axis as the source of truth makes both exact.
+
+The predicate semantics (satisfiesPodsAffinityAntiAffinity :1421-1520):
+  * affinity:  ∀ term: node-has-key ∧ domain-count > 0, with the first-pod
+    escape (:1436-1440): total potential matches == 0 ∧ pod matches its own
+    terms ⇒ pass on every node;
+  * anti-affinity: ∄ term with count > 0 in-domain;
+  * existing-pod symmetry (:1319-1360): node blocked iff some anti-term matches
+    the incoming pod and has a holder in the node's domain.
+
+CNT_node/HOLD_node live in the assignment scan's carry so pods placed earlier in
+the cycle are visible to later pods — the device analog of the assume cache
+(scheduler.go:676, cache.go:283).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..state.arrays import (
+    Array,
+    LabelSetTable,
+    NodeArrays,
+    PodArrays,
+    PodClassTable,
+    TermTable,
+)
+from .labels import ns_bit, term_labelset_matrix
+
+
+def term_class_matrix(
+    terms: TermTable, labelsets: LabelSetTable, classes: PodClassTable
+) -> Array:
+    """TM [S, SC] bool: term s (selector ∧ namespaces) matches pod-class c."""
+    M = term_labelset_matrix(terms, labelsets)  # [S, SL]
+    sel = jnp.take_along_axis(
+        M, jnp.maximum(classes.labelset, 0)[None, :], axis=1
+    )  # [S, SC]
+    nsok = ns_bit(terms.ns_words[:, None, :], classes.ns[None, :])  # [S, SC]
+    return sel & nsok & classes.valid[None, :] & terms.valid[:, None]
+
+
+def class_term_membership(term_ids: Array, S: int) -> Array:
+    """[SC, A] term-id slots → [SC, S] multi-hot membership (-1 pads dropped)."""
+    ids = term_ids
+    hot = (ids[..., None] == jnp.arange(S)[None, None, :]) & (ids[..., None] >= 0)
+    return hot.any(axis=1)  # [SC, S]
+
+
+def per_node_counts(TM_or_membership: Array, pods: PodArrays, N: int) -> Array:
+    """[S, E]-style values scattered by each existing pod's node → [S, N] i32.
+    TM_or_membership: [S, SC] (term matches class). Counts matching existing
+    pods per node — the node-axis source of truth for all domain aggregations."""
+    vals = TM_or_membership  # [S, SC]
+    node_e = pods.node_id  # [E]
+    on_node = (node_e >= 0) & pods.valid
+    per_e = jnp.take_along_axis(
+        vals, jnp.maximum(pods.cls, 0)[None, :], axis=1
+    ) & on_node[None, :]  # [S, E]
+    idx = jnp.where(on_node, node_e, N)[None, :].repeat(vals.shape[0], axis=0)
+    out = jnp.zeros((vals.shape[0], N + 1), jnp.int32)
+    out = out.at[jnp.arange(vals.shape[0])[:, None], idx].add(per_e.astype(jnp.int32))
+    return out[:, :N]
+
+
+def domain_of_term(nodes: NodeArrays, topo_key: Array) -> tuple[Array, Array]:
+    """topo_key: [S] → (dom [S, N] compact domain index with -1 absent,
+    has_key [S, N])."""
+    k = jnp.maximum(topo_key, 0)
+    dom = nodes.domain[:, k].T  # [S, N]
+    dom = jnp.where((topo_key[:, None] >= 0) & nodes.valid[None, :], dom, -1)
+    return dom, dom >= 0
+
+
+def domain_agg(
+    cnt_rows: Array,   # [A, N] per-node counts for A terms
+    dom: Array,        # [A, N] compact domain index (-1 absent)
+    D: int,
+    eligible: Array | None = None,  # [N] or [A, N] node mask, optional
+) -> Array:
+    """Aggregate per-node counts over topology domains → [A, D+1] (slot D is
+    the discard bucket). Optionally restrict to eligible nodes (spread)."""
+    vals = cnt_rows
+    if eligible is not None:
+        vals = jnp.where(eligible, vals, 0)
+    idx = jnp.where(dom >= 0, dom, D)
+    A = vals.shape[0]
+    seg = jnp.zeros((A, D + 1), jnp.int32)
+    return seg.at[jnp.arange(A)[:, None], idx].add(vals)
+
+
+def affinity_rows(
+    cls: Array,              # scalar class id
+    classes: PodClassTable,
+    terms: TermTable,
+    TM: Array,               # [S, SC]
+    CNT_node: Array,         # [S, N]
+    HOLD_node: Array,        # [S, N]
+    nodes: NodeArrays,
+    D: int,
+) -> tuple[Array, Array]:
+    """(affinity_ok [N], anti_ok [N]) for one pod against live counts."""
+
+    # --- required affinity (satisfiesPodsAffinityAntiAffinity :1431-1444) ---
+    ats = classes.aff_terms[cls]  # [AT]
+    s = jnp.maximum(ats, 0)
+    dom, has_key = domain_of_term(nodes, terms.topo_key[s])  # [AT, N]
+    seg = domain_agg(CNT_node[s], dom, D)                    # [AT, D+1]
+    cnt = jnp.take_along_axis(seg, jnp.where(dom >= 0, dom, D), axis=1)  # [AT, N]
+    term_ok = has_key & (cnt > 0)
+    active = ats >= 0
+    all_terms = (~active[:, None] | term_ok).all(0)  # [N]
+    total = jnp.sum(jnp.where(active[:, None] & has_key, CNT_node[s], 0))
+    self_all = (~active | TM[s, cls]).all()
+    escape = (total == 0) & self_all
+    has_any = active.any()
+    aff_ok = ~has_any | all_terms | escape
+
+    # --- incoming pod's anti-affinity (nodeMatchesAnyTopologyTerm :1447-1456) ---
+    ans = classes.anti_terms[cls]  # [AN]
+    sa = jnp.maximum(ans, 0)
+    dom_a, has_key_a = domain_of_term(nodes, terms.topo_key[sa])
+    seg_a = domain_agg(CNT_node[sa], dom_a, D)
+    cnt_a = jnp.take_along_axis(seg_a, jnp.where(dom_a >= 0, dom_a, D), axis=1)
+    blocked_own = ((ans >= 0)[:, None] & has_key_a & (cnt_a > 0)).any(0)  # [N]
+
+    # --- existing pods' anti-affinity symmetry (:1319-1360) ---
+    S = TM.shape[0]
+    dom_s, _ = domain_of_term(nodes, terms.topo_key)  # [S, N]
+    seg_h = domain_agg(HOLD_node, dom_s, D)           # [S, D+1]
+    hold = jnp.take_along_axis(seg_h, jnp.where(dom_s >= 0, dom_s, D), axis=1)
+    blocked_sym = (TM[:, cls][:, None] & (dom_s >= 0) & (hold > 0)).any(0)  # [N]
+
+    return aff_ok, ~(blocked_own | blocked_sym)
+
+
+def soft_affinity_row(
+    cls: Array,
+    classes: PodClassTable,
+    terms: TermTable,
+    CNT_node: Array,
+    nodes: NodeArrays,
+    D: int,
+) -> Array:
+    """Preferred inter-pod (anti)affinity score [N] f32, 0..100 after min/max
+    normalization (interpod_affinity.go:119-215; symmetric weighting of existing
+    pods' preferred terms is a TODO — see docs/PARITY.md)."""
+
+    def contrib(term_slots: Array, weights: Array, sign: float) -> Array:
+        s = jnp.maximum(term_slots, 0)
+        dom, has_key = domain_of_term(nodes, terms.topo_key[s])
+        seg = domain_agg(CNT_node[s], dom, D)
+        cnt = jnp.take_along_axis(seg, jnp.where(dom >= 0, dom, D), axis=1)
+        w = jnp.where(term_slots >= 0, weights, 0).astype(jnp.float32)
+        return sign * (w[:, None] * jnp.where(has_key, cnt, 0)).sum(0)
+
+    raw = contrib(classes.paff_terms[cls], classes.paff_w[cls], 1.0) + contrib(
+        classes.panti_terms[cls], classes.panti_w[cls], -1.0
+    )
+    lo = jnp.min(jnp.where(nodes.valid, raw, jnp.inf))
+    hi = jnp.max(jnp.where(nodes.valid, raw, -jnp.inf))
+    return jnp.where(hi > lo, 100.0 * (raw - lo) / jnp.maximum(hi - lo, 1e-9), 0.0)

@@ -1,0 +1,136 @@
+"""Vectorized label/selector matching.
+
+The tensor re-statement of apimachinery's labels.Requirement.Matches
+(staging/src/k8s.io/apimachinery/pkg/labels/selector.go:192-215) and
+v1helper.MatchNodeSelectorTerms. A label *set* is two parallel id arrays
+(keys, vals) padded with -1; a requirement is (key, op, values[V], int_rhs).
+
+Everything is pure broadcasting over small trailing axes (L, Q, V) so XLA fuses
+the whole thing into one elementwise kernel; the big axes (terms × nodes or
+terms × labelsets) map onto the VPU lanes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..api.types import Op
+from ..state.arrays import Array, LabelSetTable, NodeArrays, NodeTermTable, TermTable
+from ..state.vocab import INT_SENTINEL
+
+
+def _lookup(label_keys: Array, label_vals: Array, key: Array) -> tuple[Array, Array]:
+    """label_keys/vals: [..., L]; key: [...] → (has: [...], val: [...]).
+    Keys are unique within a set; -1 pads never match (-1 keys vs key>=0)."""
+    eq = (label_keys == key[..., None]) & (key[..., None] >= 0)
+    has = eq.any(-1)
+    val = jnp.max(jnp.where(eq, label_vals, -1), axis=-1)
+    return has, val
+
+
+def _lookup_int(label_keys: Array, label_ints: Array, key: Array) -> Array:
+    eq = (label_keys == key[..., None]) & (key[..., None] >= 0)
+    return jnp.max(jnp.where(eq, label_ints, INT_SENTINEL), axis=-1)
+
+
+def match_requirements(
+    req_keys: Array,   # [..., Q]
+    req_ops: Array,    # [..., Q]
+    req_vals: Array,   # [..., Q, V]
+    req_ints: Array,   # [..., Q] (or None)
+    label_keys: Array, # [..., L]
+    label_vals: Array, # [..., L]
+    label_ints: Array, # [..., L] (or None)
+) -> Array:
+    """AND over Q requirements (padded key == -1 ⇒ vacuously true) → [...] bool.
+    Semantics per labels/selector.go:192-215:
+      IN:             has && val ∈ values
+      NOT_IN:         !has || val ∉ values          (absent key satisfies NotIn)
+      EXISTS:         has
+      DOES_NOT_EXIST: !has
+      GT/LT:          has && int(val) <op> rhs      (non-numeric never matches)
+    """
+    lk = label_keys[..., None, :]  # [..., 1(Q), L]
+    lv = label_vals[..., None, :]
+    has, val = _lookup(lk, lv, req_keys)  # [..., Q]
+    in_vals = ((val[..., None] == req_vals) & (req_vals >= 0)).any(-1)  # [..., Q]
+
+    is_pad = req_keys < 0
+    res_in = has & in_vals
+    res_notin = (~has) | (~in_vals)
+    res_exists = has
+    res_dne = ~has
+
+    if label_ints is not None and req_ints is not None:
+        ival = _lookup_int(lk, label_ints[..., None, :], req_keys)
+        # both sides must parse as ints (selector.go:208-233); a non-numeric
+        # RHS is encoded as INT_SENTINEL and never matches
+        numeric = has & (ival != INT_SENTINEL) & (req_ints != INT_SENTINEL)
+        res_gt = numeric & (ival > req_ints)
+        res_lt = numeric & (ival < req_ints)
+    else:
+        res_gt = jnp.zeros_like(has)
+        res_lt = jnp.zeros_like(has)
+
+    per_req = jnp.select(
+        [
+            is_pad,
+            req_ops == Op.IN,
+            req_ops == Op.NOT_IN,
+            req_ops == Op.EXISTS,
+            req_ops == Op.DOES_NOT_EXIST,
+            req_ops == Op.GT,
+        ],
+        [jnp.ones_like(has), res_in, res_notin, res_exists, res_dne, res_gt],
+        res_lt,
+    )
+    return per_req.all(-1)
+
+
+def node_term_matrix(nterms: NodeTermTable, nodes: NodeArrays) -> Array:
+    """[SN, N] bool: does node-selector term s match node n.
+
+    v1helper.MatchNodeSelectorTerms: a term is the AND of its matchExpressions
+    (against node labels, with Gt/Lt) and matchFields (metadata.name ∈ values);
+    an empty/invalid term matches nothing (valid flag)."""
+    SN = nterms.keys.shape[0]
+    N = nodes.label_keys.shape[0]
+    expr_ok = match_requirements(
+        nterms.keys[:, None, :],            # [SN, 1, Q]
+        nterms.ops[:, None, :],
+        nterms.vals[:, None, :, :],
+        nterms.ints[:, None, :],
+        nodes.label_keys[None, :, :],       # [1, N, L]
+        nodes.label_vals[None, :, :],
+        nodes.label_ints[None, :, :],
+    )  # [SN, N]
+    field_hit = (
+        (nterms.fields[:, None, :] == nodes.name_id[None, :, None])
+        & (nterms.fields[:, None, :] >= 0)
+    ).any(-1)  # [SN, N]
+    field_ok = (nterms.nfields[:, None] == 0) | field_hit
+    return nterms.valid[:, None] & expr_ok & field_ok & nodes.valid[None, :]
+
+
+def term_labelset_matrix(terms: TermTable, labelsets: LabelSetTable) -> Array:
+    """[S, SL] bool: does pod-selector term s's label selector match label set l.
+    Label selectors use only IN/NOT_IN/EXISTS/DOES_NOT_EXIST; an empty selector
+    matches everything (labels.Everything — all requirements padded)."""
+    return match_requirements(
+        terms.req_keys[:, None, :],     # [S, 1, Q]
+        terms.req_ops[:, None, :],
+        terms.req_vals[:, None, :, :],
+        None,
+        labelsets.keys[None, :, :],     # [1, SL, L]
+        labelsets.vals[None, :, :],
+        None,
+    ) & terms.valid[:, None]
+
+
+def ns_bit(ns_words: Array, ns_id: Array) -> Array:
+    """ns_words: [..., NW] u32 bitset; ns_id: [...] → [...] bool membership."""
+    word = jnp.take_along_axis(
+        ns_words, jnp.maximum(ns_id[..., None], 0) >> 5, axis=-1
+    )[..., 0]
+    bit = (word >> (ns_id.astype(jnp.uint32) & 31)) & 1
+    return (bit == 1) & (ns_id >= 0)

@@ -1,0 +1,115 @@
+"""Static (class × node) lattice: everything that does not change as pods land.
+
+The reference evaluates ALL predicates per (pod, node) inside the scheduling
+loop (generic_scheduler.go:473-537). On TPU we split Filter/Score into:
+
+  * static parts — nodeSelector, node affinity (required + preferred), taints/
+    tolerations, spec.unschedulable — which depend only on (pod-class, node) and
+    are evaluated ONCE per cycle here, as [SC, N] tensors;
+  * dynamic parts — resources, host ports, inter-pod affinity counts, topology
+    spread counts — which depend on what landed earlier in the cycle and are
+    re-evaluated as O(N) rows inside the assignment scan (ops/assign.py), the
+    faithful analog of the reference's sequential assume semantics
+    (scheduler.go:676 → cache.go:283).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..state.arrays import Array, ClusterTables, PodArrays
+from .interpod import class_term_membership, per_node_counts, term_class_matrix
+from .labels import node_term_matrix
+from .taints import taint_matrices, taint_toleration_score
+from .topospread import eligible_domains
+
+
+class StaticLattice(NamedTuple):
+    mask: Array        # [SC, N] — static Filter conjunction
+    node_match: Array  # [SC, N] — nodeSelector ∧ node-affinity only (spread eligibility)
+    score: Array       # [SC, N] f32 — static Score sum (preferred node affinity,
+                       #   taint PreferNoSchedule), already 0..100-normalized per part
+
+
+class CycleArrays(NamedTuple):
+    """Per-cycle precomputed tensors fed to the assignment scan."""
+
+    static: StaticLattice
+    TM: Array        # [S, SC] term × class match
+    has_anti: Array  # [SC, S] class anti-term membership
+    CNT: Array       # [S, N] per-node term match counts (live carry seed)
+    HOLD: Array      # [S, N] per-node anti-term holder counts (live carry seed)
+    ELD: Array       # [SC, TS, D+1] eligible domains per class × constraint
+
+
+def _safe_row_gather(M: Array, ids: Array, default: bool) -> Array:
+    """M: [SN, N]; ids: [...] with -1 ⇒ `default` row."""
+    rows = M[jnp.maximum(ids, 0)]
+    return jnp.where((ids >= 0)[..., None], rows, default)
+
+
+def build_static(
+    tables: ClusterTables, unschedulable_key: int, empty_val: int
+) -> StaticLattice:
+    nodes, classes = tables.nodes, tables.classes
+
+    MT = node_term_matrix(tables.nterms, nodes)  # [SN, N]
+
+    # spec.nodeSelector (PodMatchNodeSelector half, predicates.go:879-886)
+    nsel_ok = _safe_row_gather(MT, classes.nsel_term, True)  # [SC, N]
+
+    # node affinity required: OR of terms (predicates.go:894-906); present but
+    # term-less affinity matches nothing
+    term_rows = _safe_row_gather(MT, classes.nterm_ids, False)  # [SC, T, N]
+    aff_any = term_rows.any(axis=1)
+    aff_ok = (~classes.aff_active)[:, None] | aff_any
+
+    node_match = nsel_ok & aff_ok & nodes.valid[None, :]
+
+    # taints (PodToleratesNodeTaints) + spec.unschedulable (CheckNodeUnschedulable)
+    tol_ok, prefer_cnt, unsched_ok = taint_matrices(
+        tables.tolsets, nodes, unschedulable_key, empty_val
+    )
+    ts = classes.tolset  # [SC]
+    taint_ok = tol_ok[ts]  # [SC, N]
+    unsched_pass = (~nodes.unschedulable)[None, :] | unsched_ok[ts][:, None]
+
+    mask = node_match & taint_ok & unsched_pass & classes.valid[:, None]
+
+    # --- static scores ---
+    # preferred node affinity (node_affinity.go:34-80): Σ weight·match, then
+    # NormalizeReduce(100, false) per pod-class across nodes
+    pref_rows = _safe_row_gather(MT, classes.pterm_ids, False)  # [SC, PT, N]
+    w = jnp.where(classes.pterm_ids >= 0, classes.pterm_w, 0).astype(jnp.float32)
+    pref_raw = (w[:, :, None] * pref_rows).sum(axis=1)  # [SC, N]
+    mx = pref_raw.max(axis=1, keepdims=True)
+    pref_score = jnp.where(mx > 0, pref_raw * 100.0 / jnp.maximum(mx, 1e-9), 0.0)
+
+    taint_score = taint_toleration_score(prefer_cnt[ts])  # [SC, N]
+
+    return StaticLattice(mask=mask, node_match=node_match, score=pref_score + taint_score)
+
+
+def build_cycle(
+    tables: ClusterTables,
+    existing: PodArrays,
+    unschedulable_key: int,
+    empty_val: int,
+    D: int,
+) -> CycleArrays:
+    """Everything the scan needs, computed in one fused pass on device.
+    The analog of RunPreFilterPlugins + GetPredicateMetadata
+    (generic_scheduler.go:206, metadata.go:334) — but once per *cycle*, shared
+    by every pod, instead of once per pod. `D` (domain-axis capacity) must be
+    static under jit — pass via static_argnums/partial."""
+    static = build_static(tables, unschedulable_key, empty_val)
+    TM = term_class_matrix(tables.terms, tables.labelsets, tables.classes)
+    S = TM.shape[0]
+    N = tables.nodes.valid.shape[0]
+    has_anti = class_term_membership(tables.classes.anti_terms, S)
+    CNT = per_node_counts(TM, existing, N)
+    HOLD = per_node_counts(has_anti.T, existing, N)
+    ELD = eligible_domains(static.node_match, tables.classes, tables.nodes, D)
+    return CycleArrays(static=static, TM=TM, has_anti=has_anti, CNT=CNT, HOLD=HOLD, ELD=ELD)

@@ -1,0 +1,40 @@
+"""Host-port conflict checking as bitset tensor ops.
+
+Reference semantics: PodFitsHostPorts (predicates.go:1104-1120) over the node's
+HostPortInfo (nodeinfo/node_info.go): a wanted (proto, ip, port) conflicts with
+an existing one iff same proto+port and (either side is the 0.0.0.0 wildcard or
+the IPs are equal).
+
+Encoding (state/encode.py): (proto,port) pairs and (proto,port,ip) triples are
+interned; each node carries three uint32 bitsets —
+  pair_any : pairs used by any pod (any IP)
+  pair_wild: pairs used with the wildcard IP
+  triple   : exact (proto,port,ip) triples in use
+and each port-set class carries the matching union word-masks, so a conflict
+check is three ANDs over words.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..state.arrays import Array, NodeArrays, PortSetTable
+
+
+def port_conflict_matrix(portsets: PortSetTable, nodes: NodeArrays) -> Array:
+    """[SPP, N] bool — True where the port-set CONFLICTS with the node."""
+    wild_hits = portsets.wild_words[:, None, :] & nodes.port_pair_any[None, :, :]
+    spec_hits = portsets.pair_words[:, None, :] & nodes.port_pair_wild[None, :, :]
+    trip_hits = portsets.trip_words[:, None, :] & nodes.port_triple[None, :, :]
+    return (
+        ((wild_hits | spec_hits) != 0).any(-1) | (trip_hits != 0).any(-1)
+    )
+
+
+def port_conflict_row(
+    wild_words: Array, pair_words: Array, trip_words: Array,
+    ppa: Array, ppw: Array, ppt: Array,
+) -> Array:
+    """[N] bool conflict for one port-set against live node bitsets (scan path)."""
+    hits = (wild_words[None, :] & ppa) | (pair_words[None, :] & ppw)
+    return (hits != 0).any(-1) | ((trip_words[None, :] & ppt) != 0).any(-1)

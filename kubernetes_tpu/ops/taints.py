@@ -1,0 +1,83 @@
+"""Taints/tolerations as tensor ops.
+
+Reference semantics: PodToleratesNodeTaints (predicates.go:1543-1549) filters on
+NoSchedule + NoExecute taints; PreferNoSchedule feeds the taint_toleration.go
+score (count of intolerable PreferNoSchedule taints, max-normalized + reversed).
+Toleration matching is v1helper ToleratesTaint: effect matches (empty = all),
+key matches (empty key + Exists = all), then Exists | value equality.
+
+Also covers CheckNodeUnschedulablePredicate (predicates.go:1522-1541): node
+.spec.unschedulable acts as a synthetic NoSchedule taint with a well-known key.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..api.types import TaintEffect, TolerationOp
+from ..state.arrays import Array, NodeArrays, TolSetTable
+
+
+def _tolerates(
+    tol_valid: Array,   # [..., TL]
+    tol_keys: Array,    # [..., TL]
+    tol_ops: Array,     # [..., TL]
+    tol_vals: Array,    # [..., TL]
+    tol_effects: Array, # [..., TL]
+    taint_key: Array,   # [...]
+    taint_val: Array,   # [...]
+    taint_effect: Array # [...]
+) -> Array:
+    """[...] bool: any toleration in the set tolerates the given taint."""
+    tk, tv, te = taint_key[..., None], taint_val[..., None], taint_effect[..., None]
+    eff_ok = (tol_effects < 0) | (tol_effects == te)
+    key_ok = (tol_keys < 0) | (tol_keys == tk)
+    val_ok = (tol_ops == TolerationOp.EXISTS) | (tol_vals == tv)
+    return (tol_valid & eff_ok & key_ok & val_ok).any(-1)
+
+
+def taint_matrices(
+    tolsets: TolSetTable, nodes: NodeArrays, unschedulable_key: int, empty_val: int
+) -> tuple[Array, Array, Array]:
+    """Returns:
+      ok        [STL, N] bool — all NoSchedule/NoExecute taints tolerated
+      prefer    [STL, N] i32  — count of intolerable PreferNoSchedule taints
+      unsched_ok[STL]    bool — tolerates the synthetic unschedulable taint
+    """
+    # [STL, 1, 1, TL] vs taints [1, N, TT]
+    tol = lambda a: a[:, None, None, :]
+    per_taint = _tolerates(
+        tol(tolsets.valid), tol(tolsets.keys), tol(tolsets.ops),
+        tol(tolsets.vals), tol(tolsets.effects),
+        nodes.taint_keys[None, :, :],
+        nodes.taint_vals[None, :, :],
+        nodes.taint_effects[None, :, :],
+    )  # [STL, N, TT]
+    present = nodes.taint_keys[None, :, :] >= 0
+    filtering = present & (
+        (nodes.taint_effects[None, :, :] == TaintEffect.NO_SCHEDULE)
+        | (nodes.taint_effects[None, :, :] == TaintEffect.NO_EXECUTE)
+    )
+    ok = (~filtering | per_taint).all(-1)
+    prefer = (
+        present
+        & (nodes.taint_effects[None, :, :] == TaintEffect.PREFER_NO_SCHEDULE)
+        & ~per_taint
+    ).sum(-1)
+
+    unsched_ok = _tolerates(
+        tolsets.valid, tolsets.keys, tolsets.ops, tolsets.vals, tolsets.effects,
+        jnp.full((tolsets.valid.shape[0],), unschedulable_key, jnp.int32),
+        jnp.full((tolsets.valid.shape[0],), empty_val, jnp.int32),
+        jnp.full((tolsets.valid.shape[0],), int(TaintEffect.NO_SCHEDULE), jnp.int32),
+    )  # [STL]
+    return ok, prefer, unsched_ok
+
+
+def taint_toleration_score(prefer_counts: Array) -> Array:
+    """[..., N] i32 counts → 0..100 score per row, reversed max-normalization
+    (taint_toleration.go ComputeTaintTolerationPriorityReduce via
+    NormalizeReduce(MaxNodeScore, reverse=true))."""
+    c = prefer_counts.astype(jnp.float32)
+    mx = jnp.max(c, axis=-1, keepdims=True)
+    return jnp.where(mx > 0, 100.0 * (1.0 - c / jnp.maximum(mx, 1.0)), 100.0)

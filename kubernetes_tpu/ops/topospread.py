@@ -1,0 +1,87 @@
+"""PodTopologySpread (EvenPodsSpread) as tensor ops.
+
+Reference semantics: EvenPodsSpreadPredicate (predicates.go:1643-1703) with
+metadata (metadata.go:114-176): for each hard (DoNotSchedule) constraint,
+  skew = matchNum(node's pair) + selfMatch − minMatchNum  must be ≤ maxSkew,
+where matchNum counts same-namespace existing pods matching the constraint's
+selector in the candidate node's topology domain — counting ONLY pods on nodes
+that pass the incoming pod's nodeSelector/node-affinity (metadata.go:145-151
+skips ineligible nodes) — and minMatchNum is the minimum over eligible domains
+(the 2-slot criticalPaths online-min, metadata.go:78-112, becomes a masked min
+over the domain axis). A node lacking the topology key fails; a pod whose
+eligible-domain map is empty passes everywhere (predicates.go:1661-1663).
+
+Constraint selectors are interned as terms with namespaces={pod.namespace}, so
+counts come from the same CNT_node[S, N] carry as inter-pod affinity and stay
+live as pods land during the assignment scan; eligibility masking happens at
+aggregation time per class.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..state.arrays import Array, NodeArrays, PodClassTable, TermTable
+from .interpod import domain_agg, domain_of_term
+
+
+def eligible_domains(
+    node_match: Array,     # [SC, N] — nodeSelector ∧ node-affinity only
+    classes: PodClassTable,
+    nodes: NodeArrays,
+    D: int,
+) -> Array:
+    """ELD [SC, TS, D+1] bool: domains (of each constraint's key) containing at
+    least one node eligible for the class (metadata.go:145-151's node filter)."""
+    SC, TS = classes.tsc_key.shape
+    k = jnp.maximum(classes.tsc_key, 0)          # [SC, TS]
+    dom = nodes.domain[:, k]                      # [N, SC, TS]
+    ok = (
+        node_match.T[:, :, None]
+        & (dom >= 0)
+        & (classes.tsc_key >= 0)[None, :, :]
+        & nodes.valid[:, None, None]
+    )  # [N, SC, TS]
+    idx = jnp.where(ok, dom, D)
+    eld = jnp.zeros((SC, TS, D + 1), bool)
+    return eld.at[
+        jnp.arange(SC)[None, :, None], jnp.arange(TS)[None, None, :], idx
+    ].max(ok)
+
+
+def spread_row(
+    cls: Array,            # scalar class id
+    classes: PodClassTable,
+    terms: TermTable,
+    TM: Array,             # [S, SC]
+    CNT_node: Array,       # [S, N] live per-node match counts
+    ELD: Array,            # [SC, TS, D+1]
+    node_match_row: Array, # [N] — this class's nodeSelector/affinity eligibility
+    nodes: NodeArrays,
+    D: int,
+) -> Array:
+    """[N] bool: all hard spread constraints satisfied on each node."""
+    s_ids = classes.tsc_term[cls]      # [TS]
+    s = jnp.maximum(s_ids, 0)
+    hard = classes.tsc_hard[cls] & (s_ids >= 0)  # [TS]
+    skew_max = classes.tsc_maxskew[cls]
+
+    dom, has_key = domain_of_term(nodes, terms.topo_key[s])  # [TS, N]
+    # counts restricted to nodes eligible for this pod (metadata.go:145-151)
+    seg = domain_agg(CNT_node[s], dom, D, eligible=node_match_row[None, :])  # [TS, D+1]
+    cnt = jnp.take_along_axis(seg, jnp.where(dom >= 0, dom, D), axis=1)     # [TS, N]
+
+    eld = ELD[cls]  # [TS, D+1]
+    any_eligible = eld[:, :D].any(-1)  # [TS]
+    min_cnt = jnp.min(
+        jnp.where(eld[:, :D], seg[:, :D], jnp.iinfo(jnp.int32).max), axis=-1
+    )  # [TS]
+    self_match = TM[s, cls]  # [TS] — constraint selector vs own labels
+
+    skew = cnt + self_match[:, None].astype(jnp.int32) - min_cnt[:, None]
+    ok = has_key & (skew <= skew_max[:, None])
+    # empty eligible-domain map ⇒ constraint passes everywhere (:1661-1663)
+    per_constraint = jnp.where(
+        (hard & any_eligible)[:, None], ok, jnp.ones_like(ok)
+    )
+    return per_constraint.all(0)

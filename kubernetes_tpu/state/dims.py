@@ -1,0 +1,71 @@
+"""Static capacity configuration for device arrays.
+
+Everything under jit needs static shapes (XLA compiles per shape signature), so
+ragged host data — labels per node, terms per pod, values per requirement — is
+packed into fixed-capacity slots chosen at encode time and rounded up to coarse
+buckets so recompiles are rare. The reference has no such constraint (Go maps
+and slices everywhere); this module is where its ragged world becomes rectangular.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+def bucket(n: int, minimum: int = 1) -> int:
+    """Round up to the next power of two (≥ minimum) so shape signatures are
+    stable as the cluster grows; one recompile per doubling."""
+    n = max(n, minimum)
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@dataclass(frozen=True)
+class Dims:
+    """All array capacities. Fields are hashable/static for jit."""
+
+    N: int = 8        # nodes
+    P: int = 8        # pending pods per cycle batch
+    E: int = 8        # existing (bound/assumed) pods
+    R: int = 4        # resource dims (4 fixed + scalar slots)
+    L: int = 8        # labels per node
+    PL: int = 8       # labels per pod
+    NSE: int = 4      # spec.nodeSelector equality pairs per pod
+    T: int = 4        # required node-affinity terms per pod
+    PT: int = 4       # preferred node-affinity terms per pod
+    Q: int = 4        # requirements per node-selector term / selector
+    V: int = 4        # values per requirement
+    F: int = 2        # matchFields name values per term
+    TL: int = 4       # tolerations per pod
+    TT: int = 4       # taints per node
+    PP: int = 4       # host ports per pod
+    AT: int = 2       # required pod-affinity terms per pod
+    AN: int = 2       # required pod-anti-affinity terms per pod
+    PAT: int = 2      # preferred pod-affinity terms per pod
+    PAN: int = 2      # preferred pod-anti-affinity terms per pod
+    TS: int = 2       # topology-spread constraints per pod
+    S: int = 8        # interned pod-selector term table size
+    SR: int = 8       # distinct request vectors
+    SL: int = 8       # distinct pod label sets
+    SN: int = 8       # distinct node-selector terms
+    STL: int = 4      # distinct toleration sets
+    SPP: int = 4      # distinct host-port sets
+    SC: int = 8       # distinct pod classes (templates)
+    K: int = 4        # topology keys
+    D: int = 8        # max domains per topology key
+    NW: int = 1       # namespace bitset words (32 ns per word)
+    PWp: int = 1      # (proto,port) pair bitset words
+    PWt: int = 1      # (proto,port,ip) triple bitset words
+
+    def grown_for(self, **mins: int) -> "Dims":
+        """Return dims with each named capacity bucketed up to at least the
+        given minimum (never shrinks)."""
+        updates = {}
+        for name, m in mins.items():
+            cur = getattr(self, name)
+            need = bucket(m, 1)
+            if need > cur:
+                updates[name] = need
+        return replace(self, **updates) if updates else self

@@ -1,0 +1,549 @@
+"""Host → device encoding: Pod/Node object graphs become flat class-interned arrays.
+
+The analog of the reference's snapshot construction (internal/cache/cache.go:204-255
+UpdateNodeInfoSnapshot + nodeinfo/snapshot/snapshot.go), except the snapshot is a
+set of rectangular int32 tensors ready for one pjit'd lattice evaluation, strings
+are interned (state/vocab.py), and pod specs are deduplicated into equivalence
+classes (state/arrays.py docstring).
+
+The Encoder is long-lived: vocab/registry ids are append-only across cycles so
+device arrays can be patched incrementally (state/cache.py) instead of re-encoded.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api.types import (
+    NUM_FIXED_RES,
+    RES_PODS,
+    HostPort,
+    LabelSelector,
+    Node,
+    NodeSelector,
+    NodeSelectorTerm,
+    Op,
+    Pod,
+    PodAffinityTerm,
+    Requirement,
+    Resources,
+)
+from .arrays import (
+    ClusterTables,
+    LabelSetTable,
+    NodeArrays,
+    NodeTermTable,
+    PodArrays,
+    PodClassTable,
+    PortSetTable,
+    ReqTable,
+    TermTable,
+    TolSetTable,
+)
+from .dims import Dims
+from .vocab import Vocab, VocabSet, parse_label_int
+
+I32 = np.int32
+U32 = np.uint32
+
+
+def _set_bit(words: np.ndarray, idx: int) -> None:
+    words[idx >> 5] |= U32(1) << U32(idx & 31)
+
+
+def nsel_as_term(node_selector: Dict[str, str]) -> NodeSelectorTerm:
+    """spec.nodeSelector lowered to an AND-of-IN node term
+    (predicates.go:879-886 uses labels.SelectorFromSet — equality match)."""
+    return NodeSelectorTerm(
+        requirements=tuple(
+            Requirement(k, Op.IN, (v,)) for k, v in sorted(node_selector.items())
+        )
+    )
+
+
+class Encoder:
+    """Stateful interner: object graphs → integer ids → numpy tables."""
+
+    def __init__(self) -> None:
+        self.vocabs = VocabSet()
+        self.req_reg = Vocab()       # resource-vector tuples
+        self.labelset_reg = Vocab()  # sorted ((key_id, val_id), …)
+        self.nterm_reg = Vocab()     # ((key_id, op, val_ids, int_rhs), …), field_ids
+        self.tolset_reg = Vocab()    # toleration tuples
+        self.portset_reg = Vocab()   # host-port tuples
+        self.term_reg = Vocab()      # (sel req tuple, ns_id tuple, topo_key_id)
+        self.class_reg = Vocab()     # the full pod-spec tuple
+        self._class_spec: List[tuple] = []  # parallel to class_reg ids
+
+    # ---------------- sub-object interning ---------------- #
+
+    def req_id(self, r: Resources) -> int:
+        scalars = tuple(
+            (self.vocabs.resources.intern(name), amt) for name, amt in r.scalars
+        )
+        return self.req_reg.intern(
+            (r.milli_cpu, r.memory_kib, r.ephemeral_kib, scalars)
+        )
+
+    def labelset_id(self, labels: Dict[str, str]) -> int:
+        key = tuple(
+            sorted(
+                (self.vocabs.label_keys.intern(k), self.vocabs.label_vals.intern(v))
+                for k, v in labels.items()
+            )
+        )
+        return self.labelset_reg.intern(key)
+
+    def nterm_id(self, term: NodeSelectorTerm) -> int:
+        reqs = []
+        for r in term.requirements:
+            kid = self.vocabs.label_keys.intern(r.key)
+            vids = tuple(self.vocabs.label_vals.intern(v) for v in r.values)
+            rhs = parse_label_int(r.values[0]) if (r.op in (Op.GT, Op.LT) and r.values) else 0
+            reqs.append((kid, int(r.op), vids, rhs))
+        fields = tuple(self.vocabs.node_names.intern(f) for f in term.field_name_in)
+        return self.nterm_reg.intern((tuple(reqs), fields))
+
+    def tolset_id(self, tols) -> int:
+        key = []
+        for t in tols:
+            kid = self.vocabs.label_keys.intern(t.key) if t.key else -1
+            # value is always interned — "" is a real value that must compare
+            # equal to an empty taint value (toleration.go:49-50)
+            vid = self.vocabs.label_vals.intern(t.value)
+            eff = -1 if t.effect is None else int(t.effect)
+            key.append((kid, int(t.op), vid, eff))
+        return self.tolset_reg.intern(tuple(key))
+
+    def portset_id(self, ports: Sequence[HostPort]) -> int:
+        key = []
+        for hp in ports:
+            if hp.port == 0:
+                continue
+            pair = self.vocabs.port_pairs.intern((hp.protocol, hp.port))
+            wild = hp.host_ip in ("", "0.0.0.0")
+            trip = -1 if wild else self.vocabs.port_triples.intern(
+                (hp.protocol, hp.port, hp.host_ip)
+            )
+            key.append((pair, trip, wild))
+        return self.portset_reg.intern(tuple(sorted(key)))
+
+    def term_id(self, selector: LabelSelector, namespaces: Sequence[str], topo_key: str) -> int:
+        reqs = []
+        for r in selector.requirements:
+            kid = self.vocabs.label_keys.intern(r.key)
+            vids = tuple(sorted(self.vocabs.label_vals.intern(v) for v in r.values))
+            reqs.append((kid, int(r.op), vids))
+        ns_ids = tuple(sorted(self.vocabs.namespaces.intern(n) for n in namespaces))
+        tk = self.vocabs.topo_keys.intern(topo_key)
+        self.vocabs.label_keys.intern(topo_key)  # topo keys are label keys
+        return self.term_reg.intern((tuple(reqs), ns_ids, tk))
+
+    def pod_term_id(self, term: PodAffinityTerm, owner: Pod) -> int:
+        ns = term.namespaces if term.namespaces else (owner.namespace,)
+        return self.term_id(term.selector, ns, term.topology_key)
+
+    # ---------------- class interning ---------------- #
+
+    def class_id(self, p: Pod) -> int:
+        ns_id = self.vocabs.namespaces.intern(p.namespace)
+        rid = self.req_id(p.requests)
+        ls = self.labelset_id(p.labels)
+        nsel = self.nterm_id(nsel_as_term(p.node_selector)) if p.node_selector else -1
+        aff_active = p.affinity.node_required is not None
+        nterms = tuple(
+            self.nterm_id(t) for t in (p.affinity.node_required.terms if aff_active else ())
+            if (t.requirements or t.field_name_in)
+        )
+        pterms = tuple(
+            (self.nterm_id(w.term), w.weight)
+            for w in p.affinity.node_preferred
+            if (w.term.requirements or w.term.field_name_in)
+        )
+        tol = self.tolset_id(p.tolerations)
+        ports = self.portset_id(p.host_ports)
+        aff = tuple(self.pod_term_id(t, p) for t in p.affinity.pod_required)
+        anti = tuple(self.pod_term_id(t, p) for t in p.affinity.anti_required)
+        paff = tuple((self.pod_term_id(w.term, p), w.weight) for w in p.affinity.pod_preferred)
+        panti = tuple((self.pod_term_id(w.term, p), w.weight) for w in p.affinity.anti_preferred)
+        tsc = tuple(
+            (
+                self.term_id(c.selector, (p.namespace,), c.topology_key),
+                self.vocabs.topo_keys.intern(c.topology_key),
+                c.max_skew,
+                int(c.when_unsatisfiable) == 0,
+            )
+            for c in p.topology_spread
+        )
+        spec = (ns_id, rid, ls, nsel, aff_active, nterms, pterms, tol, ports,
+                aff, anti, paff, panti, tsc)
+        before = len(self.class_reg)
+        cid = self.class_reg.intern(spec)
+        if cid == before:
+            self._class_spec.append(spec)
+        return cid
+
+    def intern_node(self, n: Node) -> None:
+        self.vocabs.node_names.intern(n.name)
+        for k, v in n.labels.items():
+            self.vocabs.label_keys.intern(k)
+            self.vocabs.label_vals.intern(v)
+        for t in n.taints:
+            self.vocabs.label_keys.intern(t.key)
+            self.vocabs.label_vals.intern(t.value)
+        for name, _ in n.allocatable.scalars:
+            self.vocabs.resources.intern(name)
+
+    # ---------------- capacity computation ---------------- #
+
+    def dims(
+        self,
+        n_nodes: int,
+        n_existing: int,
+        n_pending: int,
+        nodes: Sequence[Node],
+        base: Optional[Dims] = None,
+    ) -> Dims:
+        d = base or Dims()
+        v = self.vocabs
+
+        def mx(it, default=1):
+            vals = list(it)
+            return max(vals) if vals else default
+
+        nterm_specs = [self.nterm_reg.lookup(i) for i in range(len(self.nterm_reg))]
+        term_specs = [self.term_reg.lookup(i) for i in range(len(self.term_reg))]
+        tol_specs = [self.tolset_reg.lookup(i) for i in range(len(self.tolset_reg))]
+        port_specs = [self.portset_reg.lookup(i) for i in range(len(self.portset_reg))]
+
+        max_q = mx([len(s[0]) for s in nterm_specs] + [len(s[0]) for s in term_specs])
+        max_v = mx(
+            [len(r[2]) for s in nterm_specs for r in s[0]]
+            + [len(r[2]) for s in term_specs for r in s[0]]
+        )
+        max_domains = 1
+        for ki in range(len(v.topo_keys)):
+            key = v.topo_keys.lookup(ki)
+            max_domains = max(
+                max_domains, len({n.labels[key] for n in nodes if key in n.labels})
+            )
+
+        return d.grown_for(
+            N=n_nodes, P=max(n_pending, 1), E=max(n_existing, 1),
+            R=NUM_FIXED_RES + len(v.resources),
+            L=mx([len(n.labels) for n in nodes]),
+            PL=mx([len(s) for i in range(len(self.labelset_reg))
+                   for s in [self.labelset_reg.lookup(i)]]),
+            T=mx([len(s[5]) for s in self._class_spec]),
+            PT=mx([len(s[6]) for s in self._class_spec]),
+            Q=max_q, V=max_v,
+            F=mx([len(s[1]) for s in nterm_specs]),
+            TL=mx([len(s) for s in tol_specs]),
+            TT=mx([len(n.taints) for n in nodes]),
+            PP=mx([len(s) for s in port_specs]),
+            AT=mx([len(s[9]) for s in self._class_spec]),
+            AN=mx([len(s[10]) for s in self._class_spec]),
+            PAT=mx([len(s[11]) for s in self._class_spec]),
+            PAN=mx([len(s[12]) for s in self._class_spec]),
+            TS=mx([len(s[13]) for s in self._class_spec]),
+            S=max(len(self.term_reg), 1),
+            SR=max(len(self.req_reg), 1),
+            SL=max(len(self.labelset_reg), 1),
+            SN=max(len(self.nterm_reg), 1),
+            STL=max(len(self.tolset_reg), 1),
+            SPP=max(len(self.portset_reg), 1),
+            SC=max(len(self.class_reg), 1),
+            K=max(len(v.topo_keys), 1),
+            D=max_domains,
+            NW=(len(v.namespaces) + 31) // 32 or 1,
+            PWp=(len(v.port_pairs) + 31) // 32 or 1,
+            PWt=(len(v.port_triples) + 31) // 32 or 1,
+        )
+
+    # ---------------- table materialization ---------------- #
+
+    def build_req_table(self, d: Dims) -> ReqTable:
+        vec = np.zeros((d.SR, d.R), I32)
+        for i in range(len(self.req_reg)):
+            cpu, mem, eph, scalars = self.req_reg.lookup(i)
+            vec[i, 0], vec[i, 1], vec[i, 2] = cpu, mem, eph
+            vec[i, RES_PODS] = 1
+            for sid, amt in scalars:
+                vec[i, NUM_FIXED_RES + sid] = amt
+        return ReqTable(vec=vec)
+
+    def build_labelset_table(self, d: Dims) -> LabelSetTable:
+        keys = np.full((d.SL, d.PL), -1, I32)
+        vals = np.full((d.SL, d.PL), -1, I32)
+        for i in range(len(self.labelset_reg)):
+            for li, (k, v) in enumerate(self.labelset_reg.lookup(i)):
+                keys[i, li], vals[i, li] = k, v
+        return LabelSetTable(keys=keys, vals=vals)
+
+    def build_nterm_table(self, d: Dims) -> NodeTermTable:
+        SN, Q, V, F = d.SN, d.Q, d.V, d.F
+        valid = np.zeros((SN,), bool)
+        keys = np.full((SN, Q), -1, I32)
+        ops = np.zeros((SN, Q), I32)
+        vals = np.full((SN, Q, V), -1, I32)
+        ints = np.zeros((SN, Q), I32)
+        fields = np.full((SN, F), -1, I32)
+        nfields = np.zeros((SN,), I32)
+        for i in range(len(self.nterm_reg)):
+            reqs, flds = self.nterm_reg.lookup(i)
+            valid[i] = True
+            for qi, (kid, op, vids, rhs) in enumerate(reqs):
+                keys[i, qi], ops[i, qi], ints[i, qi] = kid, op, rhs
+                for vi, vid in enumerate(vids):
+                    vals[i, qi, vi] = vid
+            for fi, f in enumerate(flds):
+                fields[i, fi] = f
+            nfields[i] = len(flds)
+        return NodeTermTable(valid=valid, keys=keys, ops=ops, vals=vals,
+                             ints=ints, fields=fields, nfields=nfields)
+
+    def build_tolset_table(self, d: Dims) -> TolSetTable:
+        STL, TL = d.STL, d.TL
+        valid = np.zeros((STL, TL), bool)
+        keys = np.full((STL, TL), -1, I32)
+        ops = np.zeros((STL, TL), I32)
+        vals = np.full((STL, TL), -1, I32)
+        effects = np.full((STL, TL), -1, I32)
+        for i in range(len(self.tolset_reg)):
+            for ti, (kid, op, vid, eff) in enumerate(self.tolset_reg.lookup(i)):
+                valid[i, ti] = True
+                keys[i, ti], ops[i, ti], vals[i, ti], effects[i, ti] = kid, op, vid, eff
+        return TolSetTable(valid=valid, keys=keys, ops=ops, vals=vals, effects=effects)
+
+    def build_portset_table(self, d: Dims) -> PortSetTable:
+        SPP, PP = d.SPP, d.PP
+        pair = np.full((SPP, PP), -1, I32)
+        triple = np.full((SPP, PP), -1, I32)
+        wild = np.zeros((SPP, PP), bool)
+        pw = np.zeros((SPP, d.PWp), U32)
+        ww = np.zeros((SPP, d.PWp), U32)
+        tw = np.zeros((SPP, d.PWt), U32)
+        for i in range(len(self.portset_reg)):
+            for pi, (pr, tr, wl) in enumerate(self.portset_reg.lookup(i)):
+                pair[i, pi], triple[i, pi], wild[i, pi] = pr, tr, wl
+                _set_bit(pw[i], pr)
+                if wl:
+                    _set_bit(ww[i], pr)
+                elif tr >= 0:
+                    _set_bit(tw[i], tr)
+        return PortSetTable(pair=pair, triple=triple, wild=wild,
+                            pair_words=pw, wild_words=ww, trip_words=tw)
+
+    def build_term_table(self, d: Dims) -> TermTable:
+        S, Q, V, NW = d.S, d.Q, d.V, d.NW
+        valid = np.zeros((S,), bool)
+        req_keys = np.full((S, Q), -1, I32)
+        req_ops = np.zeros((S, Q), I32)
+        req_vals = np.full((S, Q, V), -1, I32)
+        ns_words = np.zeros((S, NW), U32)
+        topo_key = np.full((S,), -1, I32)
+        for i in range(len(self.term_reg)):
+            reqs, ns_ids, tk = self.term_reg.lookup(i)
+            valid[i] = True
+            topo_key[i] = tk
+            for qi, (kid, op, vids) in enumerate(reqs):
+                req_keys[i, qi], req_ops[i, qi] = kid, op
+                for vi, vid in enumerate(vids):
+                    req_vals[i, qi, vi] = vid
+            for ns in ns_ids:
+                _set_bit(ns_words[i], ns)
+        return TermTable(valid=valid, req_keys=req_keys, req_ops=req_ops,
+                         req_vals=req_vals, ns_words=ns_words, topo_key=topo_key)
+
+    def build_class_table(self, d: Dims) -> PodClassTable:
+        SC = d.SC
+
+        def z(shape, fill=0, dtype=I32):
+            return np.full(shape, fill, dtype)
+
+        t = dict(
+            valid=z((SC,), False, bool), ns=z((SC,), -1), rid=z((SC,)),
+            labelset=z((SC,)), nsel_term=z((SC,), -1),
+            aff_active=z((SC,), False, bool),
+            nterm_ids=z((SC, d.T), -1), pterm_ids=z((SC, d.PT), -1),
+            pterm_w=z((SC, d.PT)), tolset=z((SC,)), portset=z((SC,), -1),
+            aff_terms=z((SC, d.AT), -1), anti_terms=z((SC, d.AN), -1),
+            paff_terms=z((SC, d.PAT), -1), paff_w=z((SC, d.PAT)),
+            panti_terms=z((SC, d.PAN), -1), panti_w=z((SC, d.PAN)),
+            tsc_term=z((SC, d.TS), -1), tsc_key=z((SC, d.TS), -1),
+            tsc_maxskew=z((SC, d.TS)), tsc_hard=z((SC, d.TS), False, bool),
+        )
+        for i, spec in enumerate(self._class_spec):
+            (ns_id, rid, ls, nsel, aff_active, nterms, pterms, tol, ports,
+             aff, anti, paff, panti, tsc) = spec
+            t["valid"][i] = True
+            t["ns"][i], t["rid"][i], t["labelset"][i] = ns_id, rid, ls
+            t["nsel_term"][i] = nsel
+            t["aff_active"][i] = aff_active
+            for ti, x in enumerate(nterms):
+                t["nterm_ids"][i, ti] = x
+            for ti, (x, w) in enumerate(pterms):
+                t["pterm_ids"][i, ti], t["pterm_w"][i, ti] = x, w
+            t["tolset"][i], t["portset"][i] = tol, ports
+            for ti, x in enumerate(aff):
+                t["aff_terms"][i, ti] = x
+            for ti, x in enumerate(anti):
+                t["anti_terms"][i, ti] = x
+            for ti, (x, w) in enumerate(paff):
+                t["paff_terms"][i, ti], t["paff_w"][i, ti] = x, w
+            for ti, (x, w) in enumerate(panti):
+                t["panti_terms"][i, ti], t["panti_w"][i, ti] = x, w
+            for ti, (x, k, skew, hard) in enumerate(tsc):
+                t["tsc_term"][i, ti], t["tsc_key"][i, ti] = x, k
+                t["tsc_maxskew"][i, ti], t["tsc_hard"][i, ti] = skew, hard
+        return PodClassTable(**t)
+
+    def build_node_arrays(
+        self, nodes: Sequence[Node], existing: Sequence[Pod], d: Dims
+    ) -> NodeArrays:
+        N, R, L, TT, K = d.N, d.R, d.L, d.TT, d.K
+        v = self.vocabs
+        valid = np.zeros((N,), bool)
+        name_id = np.full((N,), -1, I32)
+        alloc = np.zeros((N, R), I32)
+        used = np.zeros((N, R), I32)
+        label_keys = np.full((N, L), -1, I32)
+        label_vals = np.full((N, L), -1, I32)
+        label_ints = np.zeros((N, L), I32)
+        unsched = np.zeros((N,), bool)
+        taint_keys = np.full((N, TT), -1, I32)
+        taint_vals = np.full((N, TT), -1, I32)
+        taint_effects = np.full((N, TT), -1, I32)
+        topo = np.full((N, K), -1, I32)
+        domain = np.full((N, K), -1, I32)
+        ppa = np.zeros((N, d.PWp), U32)
+        ppw = np.zeros((N, d.PWp), U32)
+        ppt = np.zeros((N, d.PWt), U32)
+
+        node_index = {n.name: i for i, n in enumerate(nodes)}
+        domain_maps: List[Dict[int, int]] = [dict() for _ in range(K)]
+
+        for i, n in enumerate(nodes):
+            valid[i] = True
+            name_id[i] = v.node_names.intern(n.name)
+            av = np.zeros((R,), I32)
+            av[0], av[1], av[2] = (n.allocatable.milli_cpu,
+                                   n.allocatable.memory_kib,
+                                   n.allocatable.ephemeral_kib)
+            av[RES_PODS] = n.allocatable.pods
+            for name, amt in n.allocatable.scalars:
+                av[NUM_FIXED_RES + v.resources.intern(name)] = amt
+            alloc[i] = av
+            unsched[i] = n.unschedulable
+            for li, (k, val) in enumerate(n.labels.items()):
+                label_keys[i, li] = v.label_keys.intern(k)
+                label_vals[i, li] = v.label_vals.intern(val)
+                label_ints[i, li] = parse_label_int(val)
+            for ti, t in enumerate(n.taints):
+                taint_keys[i, ti] = v.label_keys.intern(t.key)
+                taint_vals[i, ti] = v.label_vals.intern(t.value)
+                taint_effects[i, ti] = int(t.effect)
+            for ki in range(len(v.topo_keys)):
+                key = v.topo_keys.lookup(ki)
+                if key in n.labels:
+                    vid = v.label_vals.intern(n.labels[key])
+                    topo[i, ki] = vid
+                    dm = domain_maps[ki]
+                    if vid not in dm:
+                        dm[vid] = len(dm)
+                    domain[i, ki] = dm[vid]
+
+        for p in existing:
+            ni = node_index.get(p.node_name, -1)
+            if ni < 0:
+                continue
+            rid = self.req_id(p.requests)
+            cpu, mem, eph, scalars = self.req_reg.lookup(rid)
+            used[ni, 0] += cpu
+            used[ni, 1] += mem
+            used[ni, 2] += eph
+            used[ni, RES_PODS] += 1
+            for sid, amt in scalars:
+                used[ni, NUM_FIXED_RES + sid] += amt
+            for hp in p.host_ports:
+                if hp.port == 0:
+                    continue
+                pair = v.port_pairs.intern((hp.protocol, hp.port))
+                _set_bit(ppa[ni], pair)
+                if hp.host_ip in ("", "0.0.0.0"):
+                    _set_bit(ppw[ni], pair)
+                else:
+                    _set_bit(ppt[ni], v.port_triples.intern((hp.protocol, hp.port, hp.host_ip)))
+
+        return NodeArrays(
+            valid=valid, name_id=name_id, alloc=alloc, used=used,
+            label_keys=label_keys, label_vals=label_vals, label_ints=label_ints,
+            unschedulable=unsched, taint_keys=taint_keys, taint_vals=taint_vals,
+            taint_effects=taint_effects, topo=topo, domain=domain,
+            port_pair_any=ppa, port_pair_wild=ppw, port_triple=ppt,
+        )
+
+    def build_pod_arrays(
+        self,
+        pods: Sequence[Pod],
+        d: Dims,
+        node_index: Optional[Dict[str, int]] = None,
+        capacity: Optional[int] = None,
+    ) -> PodArrays:
+        P = capacity if capacity is not None else max(len(pods), 1)
+        node_index = node_index or {}
+        v = self.vocabs
+        valid = np.zeros((P,), bool)
+        name_id = np.full((P,), -1, I32)
+        ns = np.full((P,), -1, I32)
+        cls = np.zeros((P,), I32)
+        priority = np.zeros((P,), I32)
+        creation = np.zeros((P,), I32)
+        node_id = np.full((P,), -1, I32)
+        node_name_req = np.full((P,), -1, I32)
+        for i, p in enumerate(pods):
+            valid[i] = True
+            name_id[i] = v.pod_names.intern(p.name)
+            ns[i] = v.namespaces.intern(p.namespace)
+            cls[i] = self.class_id(p)
+            priority[i] = p.priority
+            creation[i] = p.creation_index
+            if p.node_name:
+                node_name_req[i] = v.node_names.intern(p.node_name)
+                node_id[i] = node_index.get(p.node_name, -1)
+        return PodArrays(valid=valid, name_id=name_id, ns=ns, cls=cls,
+                         priority=priority, creation=creation,
+                         node_id=node_id, node_name_req=node_name_req)
+
+    # ---------------- one-shot full encode ---------------- #
+
+    def encode_cluster(
+        self,
+        nodes: Sequence[Node],
+        existing: Sequence[Pod],
+        pending: Sequence[Pod],
+        base: Optional[Dims] = None,
+    ) -> Tuple[ClusterTables, PodArrays, PodArrays, Dims]:
+        """Cold-path full encode. Interns everything, sizes capacities, builds
+        all tables. Returns (tables, existing_pods, pending_pods, dims)."""
+        for n in nodes:
+            self.intern_node(n)
+        for p in list(existing) + list(pending):
+            self.class_id(p)
+        d = self.dims(len(nodes), len(existing), len(pending), nodes, base)
+        node_index = {n.name: i for i, n in enumerate(nodes)}
+        tables = ClusterTables(
+            nodes=self.build_node_arrays(nodes, existing, d),
+            reqs=self.build_req_table(d),
+            labelsets=self.build_labelset_table(d),
+            nterms=self.build_nterm_table(d),
+            tolsets=self.build_tolset_table(d),
+            portsets=self.build_portset_table(d),
+            terms=self.build_term_table(d),
+            classes=self.build_class_table(d),
+        )
+        ex = self.build_pod_arrays(existing, d, node_index, capacity=d.E)
+        pe = self.build_pod_arrays(pending, d, node_index, capacity=d.P)
+        return tables, ex, pe, d

@@ -1,0 +1,104 @@
+"""End-to-end smoke: the minimum slice — resources + selectors + taints +
+ports scheduled in one batched call."""
+
+from kubernetes_tpu import (
+    BatchScheduler,
+    HostPort,
+    Node,
+    Pod,
+    Resources,
+    Taint,
+    TaintEffect,
+    Toleration,
+    TolerationOp,
+)
+
+
+def n(name, cpu="4", mem="8Gi", pods=110, labels=None, taints=(), unschedulable=False):
+    return Node(
+        name=name,
+        labels=labels or {},
+        allocatable=Resources.make(cpu=cpu, memory=mem, pods=pods),
+        taints=tuple(taints),
+        unschedulable=unschedulable,
+    )
+
+
+def p(name, cpu="100m", mem="128Mi", **kw):
+    return Pod(name=name, requests=Resources.make(cpu=cpu, memory=mem), **kw)
+
+
+def test_resources_pack_and_overflow():
+    nodes = [n("n0", cpu="1"), n("n1", cpu="1")]
+    pods = [p(f"p{i}", cpu="600m") for i in range(3)]
+    res = BatchScheduler().schedule(nodes, [], pods)
+    assert res.scheduled == 2
+    assert res.failed == 1
+    # the two scheduled pods landed on different nodes (600m+600m > 1 cpu)
+    placed = [a for a in res.assignments if a]
+    assert len(set(placed)) == 2
+
+
+def test_node_selector():
+    nodes = [n("n0", labels={"disk": "hdd"}), n("n1", labels={"disk": "ssd"})]
+    pods = [p("p0", node_selector={"disk": "ssd"})]
+    res = BatchScheduler().schedule(nodes, [], pods)
+    assert res.assignments == ["n1"]
+
+
+def test_taints_block_untolerated():
+    nodes = [
+        n("n0", taints=[Taint("dedicated", "gpu", TaintEffect.NO_SCHEDULE)]),
+        n("n1"),
+    ]
+    pods = [
+        p("plain"),
+        p("tolerant", tolerations=(
+            Toleration(key="dedicated", op=TolerationOp.EQUAL, value="gpu",
+                       effect=TaintEffect.NO_SCHEDULE),
+        )),
+    ]
+    res = BatchScheduler().schedule(nodes, [], pods)
+    by_name = dict(zip(["plain", "tolerant"], res.assignments))
+    assert by_name["plain"] == "n1"
+    assert by_name["tolerant"] is not None
+
+
+def test_unschedulable_node():
+    nodes = [n("n0", unschedulable=True), n("n1")]
+    res = BatchScheduler().schedule(nodes, [], [p("p0")])
+    assert res.assignments == ["n1"]
+
+
+def test_host_port_conflicts():
+    nodes = [n("n0"), n("n1")]
+    pods = [p(f"p{i}", host_ports=(HostPort(8080),)) for i in range(3)]
+    res = BatchScheduler().schedule(nodes, [], pods)
+    assert res.scheduled == 2 and res.failed == 1
+    placed = [a for a in res.assignments if a]
+    assert len(set(placed)) == 2
+
+
+def test_existing_pods_consume_capacity():
+    nodes = [n("n0", cpu="1"), n("n1", cpu="1")]
+    existing = [p("old", cpu="900m", node_name="n0")]
+    res = BatchScheduler().schedule(nodes, existing, [p("new", cpu="500m")])
+    assert res.assignments == ["n1"]
+
+
+def test_priority_order_wins_scarce_resource():
+    nodes = [n("n0", cpu="1")]
+    pods = [
+        p("low", cpu="800m", priority=0, creation_index=0),
+        p("high", cpu="800m", priority=10, creation_index=1),
+    ]
+    res = BatchScheduler().schedule(nodes, [], pods)
+    by_name = dict(zip(["low", "high"], res.assignments))
+    assert by_name["high"] == "n0"
+    assert by_name["low"] is None
+
+
+def test_spec_node_name_targets_node():
+    nodes = [n("n0"), n("n1")]
+    res = BatchScheduler().schedule(nodes, [], [p("p0", node_name="n1")])
+    assert res.assignments == ["n1"]
