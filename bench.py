@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Benchmark: the north-star config — full InterPodAffinity + PodTopologySpread
+over (pending × nodes), one batched device cycle (BASELINE.json config 4).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": pods_per_sec, "unit": "pods/s", "vs_baseline": ...}
+
+Baseline: the reference's enforced floor is 30 pods/s with warnings under 100
+(test/integration/scheduler_perf/scheduler_test.go:40-42); vs_baseline is
+measured against 100 pods/s — the reference's healthy single-box throughput.
+
+Scale via env: BENCH_NODES (default 5000), BENCH_PODS (default 50000).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+from kubernetes_tpu.models.workloads import flagship_pods, make_nodes
+from kubernetes_tpu.sched.cycle import BatchScheduler
+from kubernetes_tpu.state.dims import Dims
+
+REFERENCE_PODS_PER_SEC = 100.0
+
+
+def main() -> None:
+    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
+    n_pods = int(os.environ.get("BENCH_PODS", "50000"))
+
+    nodes = make_nodes(n_nodes)
+    pods = flagship_pods(n_pods)
+
+    # exact capacities: no padding waste on the pod axis
+    base = Dims(N=n_nodes, P=n_pods, E=1)
+
+    # warmup (compile) on the same shapes with a fresh scheduler
+    warm = BatchScheduler()
+    t0 = time.perf_counter()
+    warm.schedule(nodes, [], pods, base)
+    t_warm = time.perf_counter() - t0
+
+    sched = BatchScheduler()
+    t0 = time.perf_counter()
+    res = sched.schedule(nodes, [], pods, base)
+    t_total = time.perf_counter() - t0
+
+    pods_per_sec = res.scheduled / t_total if t_total > 0 else 0.0
+    out = {
+        "metric": f"pods scheduled/sec, {n_nodes} nodes x {n_pods} pending, "
+                  "InterPodAffinity+PodTopologySpread (config 4)",
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_sec / REFERENCE_PODS_PER_SEC, 2),
+        "detail": {
+            "scheduled": res.scheduled,
+            "failed": res.failed,
+            "cycle_seconds": round(t_total, 3),
+            "warmup_seconds": round(t_warm, 1),
+            "backend": jax.default_backend(),
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,37 +73,11 @@ def assign_batch(
     def step(state: AssignState, idx):
         c = pods.cls[idx]
         p_valid = pods.valid[idx]
-        rid = classes.rid[c]
-        req_vec = tables.reqs.vec[rid]
-
-        # ---- dynamic Filter rows ----
-        fit = fit_row(req_vec, state.used, nodes.alloc, nodes.valid)
-
+        req_vec = tables.reqs.vec[classes.rid[c]]
         ps = classes.portset[c]
         psafe = jnp.maximum(ps, 0)
-        conflict = port_conflict_row(
-            tables.portsets.wild_words[psafe],
-            tables.portsets.pair_words[psafe],
-            tables.portsets.trip_words[psafe],
-            state.ppa, state.ppw, state.ppt,
-        )
-        port_ok = (ps < 0) | ~conflict
 
-        aff_ok, anti_ok = affinity_rows(
-            c, classes, terms, cyc.TM, state.CNT, state.HOLD, nodes, D
-        )
-        spread_ok = spread_row(
-            c, classes, terms, cyc.TM, state.CNT, cyc.ELD,
-            cyc.static.node_match[c], nodes, D,
-        )
-
-        nnr = pods.node_name_req[idx]
-        host_ok = (nnr < 0) | (nodes.name_id == nnr)
-
-        mask = (
-            cyc.static.mask[c]
-            & fit & port_ok & aff_ok & anti_ok & spread_ok & host_ok
-        )
+        mask = pod_mask_row(tables, cyc, state, c, pods.node_name_req[idx], p_valid)
 
         # ---- Score row (weighted sum, all default weights 1;
         #      generic_scheduler.go:823-832) ----
@@ -142,6 +116,57 @@ def assign_batch(
     node_out = jnp.full((P,), -1, jnp.int32).at[order].set(nodes_sorted)
     feas_out = jnp.zeros((P,), bool).at[order].set(feas_sorted)
     return AssignResult(node=node_out, feasible=feas_out, state=final)
+
+
+def pod_mask_row(
+    tables: ClusterTables,
+    cyc: CycleArrays,
+    state: AssignState,
+    cls: Array,
+    node_name_req: Array,
+    valid: Array,
+) -> Array:
+    """Full Filter mask [N] for one pod against a given assume-state — the
+    tensor analog of podFitsOnNode (generic_scheduler.go:628-706). Shared by
+    the assignment scan and the golden-test / extender surfaces."""
+    nodes, classes, terms = tables.nodes, tables.classes, tables.terms
+    D = cyc.ELD.shape[2] - 1
+    rid = classes.rid[cls]
+    req_vec = tables.reqs.vec[rid]
+    fit = fit_row(req_vec, state.used, nodes.alloc, nodes.valid)
+    ps = classes.portset[cls]
+    psafe = jnp.maximum(ps, 0)
+    conflict = port_conflict_row(
+        tables.portsets.wild_words[psafe],
+        tables.portsets.pair_words[psafe],
+        tables.portsets.trip_words[psafe],
+        state.ppa, state.ppw, state.ppt,
+    )
+    port_ok = (ps < 0) | ~conflict
+    aff_ok, anti_ok = affinity_rows(
+        cls, classes, terms, cyc.TM, state.CNT, state.HOLD, nodes, D
+    )
+    spread_ok = spread_row(
+        cls, classes, terms, cyc.TM, state.CNT, cyc.ELD,
+        cyc.static.node_match[cls], nodes, D,
+    )
+    host_ok = (node_name_req < 0) | (nodes.name_id == node_name_req)
+    return (
+        cyc.static.mask[cls]
+        & fit & port_ok & aff_ok & anti_ok & spread_ok & host_ok & valid
+    )
+
+
+def feasible_matrix(
+    tables: ClusterTables, cyc: CycleArrays, pods: PodArrays
+) -> Array:
+    """[P, N] Filter mask for every pending pod against the *initial* state
+    (no assignment feedback) — findNodesThatFit (generic_scheduler.go:473) as
+    one vmapped tensor, used for golden tests and the extender Filter verb."""
+    state = initial_state(tables, cyc)
+    return jax.vmap(
+        lambda c, nnr, v: pod_mask_row(tables, cyc, state, c, nnr, v)
+    )(pods.cls, pods.node_name_req, pods.valid)
 
 
 def initial_state(tables: ClusterTables, cyc: CycleArrays) -> AssignState:

@@ -1,10 +1,12 @@
 """NodeResourcesFit and resource-based scores as tensor ops.
 
 Reference semantics: PodFitsResources (algorithm/predicates/predicates.go:789-845)
-— a pod fits iff for every resource r: request_r ≤ allocatable_r − used_r, with
-zero requests always passing (the zero-request fast path :800-806 falls out of
-the per-resource rule), plus the pod-count check used+1 ≤ allowedPodNumber
-(encoded as resource RES_PODS with request 1).
+— the pod-count check used+1 ≤ allowedPodNumber always applies; then, UNLESS the
+pod requests zero of everything (the fast path :800-806), every resource must
+satisfy request_r ≤ allocatable_r − used_r. Note the asymmetry this implies on
+overcommitted nodes: a pod requesting 0 memory still FAILS if memory free is
+negative (Go: 0 > negative ⇒ insufficient), but an all-zero pod passes — found
+by the randomized golden tests, not obvious from the prose.
 
 Scores: least_requested.go / most_requested.go / balanced_resource_allocation.go.
 The reference computes integer (cap−total)*100/cap per resource; we compute in
@@ -16,24 +18,31 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..api.types import RES_PODS
 from ..state.arrays import Array, NodeArrays, ReqTable
 
 MAX_NODE_SCORE = 100.0  # framework/v1alpha1/interface.go:87
 
 
+def _fit(vec: Array, free: Array) -> Array:
+    """vec: [..., R], free: [..., R] → [...] bool per PodFitsResources."""
+    R = vec.shape[-1]
+    is_pods = jnp.arange(R) == RES_PODS
+    pods_ok = (jnp.where(is_pods, vec, 0) <= jnp.where(is_pods, free, 0)).all(-1)
+    zero_all = jnp.where(is_pods, 0, vec).max(-1) == 0
+    res_ok = (is_pods | (vec <= free)).all(-1)
+    return pods_ok & (zero_all | res_ok)
+
+
 def fit_matrix(reqs: ReqTable, nodes: NodeArrays) -> Array:
     """[SR, N] bool: request-class r fits on node n given current `used`."""
     free = nodes.alloc - nodes.used  # [N, R]
-    vec = reqs.vec  # [SR, R]
-    ok = (vec[:, None, :] == 0) | (vec[:, None, :] <= free[None, :, :])
-    return ok.all(-1) & nodes.valid[None, :]
+    return _fit(reqs.vec[:, None, :], free[None, :, :]) & nodes.valid[None, :]
 
 
 def fit_row(req_vec: Array, used: Array, alloc: Array, valid: Array) -> Array:
     """[N] bool for one request vector against live used — the scan inner check."""
-    free = alloc - used
-    ok = (req_vec[None, :] == 0) | (req_vec[None, :] <= free)
-    return ok.all(-1) & valid
+    return _fit(req_vec[None, :], alloc - used) & valid
 
 
 def _frac(total: Array, cap: Array) -> Array:

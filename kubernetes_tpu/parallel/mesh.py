@@ -1,0 +1,73 @@
+"""Multi-chip sharding of the scheduling lattice.
+
+The reference scales Filter/Score with 16 goroutines on one box
+(workqueue.ParallelizeUntil, generic_scheduler.go:537,770) and has no multi-
+machine compute path at all — the control plane shards by *resource type*, not
+by data. The TPU-native design shards the **node axis** across chips with a
+`jax.sharding.Mesh`:
+
+  * NodeArrays rows, the static [SC, N] lattice, per-node count carries
+    (CNT/HOLD [S, N]) and the scan's [N]-wide dynamic rows are all partitioned
+    on N — each chip owns N/n_devices nodes, exactly like the reference's
+    goroutine chunking but over ICI instead of shared memory;
+  * class/term tables are small and replicated;
+  * the per-step argmax over N and `mask.any()` become cross-chip reductions —
+    XLA GSPMD inserts the collectives (psum/all-gather over ICI) from the
+    sharding annotations alone; no hand-written communication.
+
+Pod-axis (batch) sharding — the long-context analog — composes on top for the
+class-level matrices when SC×N outgrows one chip's HBM; the scan itself stays
+sequential in pods by design (assume semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..state.arrays import ClusterTables, PodArrays
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (NODE_AXIS,))
+
+
+def _node_sharded_tables_spec(tables: ClusterTables) -> ClusterTables:
+    """PartitionSpecs: NodeArrays sharded on axis 0 (the N axis); everything
+    else replicated."""
+    node_specs = type(tables.nodes)(
+        *[P(NODE_AXIS) for _ in tables.nodes]
+    )
+    rep = lambda t: type(t)(*[P() for _ in t])
+    return ClusterTables(
+        nodes=node_specs,
+        reqs=rep(tables.reqs),
+        labelsets=rep(tables.labelsets),
+        nterms=rep(tables.nterms),
+        tolsets=rep(tables.tolsets),
+        portsets=rep(tables.portsets),
+        terms=rep(tables.terms),
+        classes=rep(tables.classes),
+    )
+
+
+def shard_tables(tables: ClusterTables, mesh: Mesh) -> ClusterTables:
+    """Place tables on the mesh: node axis split across chips, rest replicated.
+    Requires dims.N % n_devices == 0 (bucketed capacities make this easy)."""
+    specs = _node_sharded_tables_spec(tables)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tables, specs
+    )
+
+
+def replicate(tree, mesh: Mesh):
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree
+    )

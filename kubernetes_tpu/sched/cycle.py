@@ -42,6 +42,23 @@ def _schedule_batch(
     return assign_batch(tables, cyc, pending, init)
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
+def _feasible(
+    tables: ClusterTables,
+    pending: PodArrays,
+    keys: Tuple[jnp.ndarray, jnp.ndarray],
+    D: int,
+    existing: PodArrays,
+) -> jnp.ndarray:
+    """[P, N] Filter mask — findNodesThatFit as one dispatch (golden tests,
+    extender Filter verb)."""
+    from ..ops.assign import feasible_matrix
+
+    uk, ev = keys
+    cyc = build_cycle(tables, existing, uk, ev, D)
+    return feasible_matrix(tables, cyc, pending)
+
+
 @dataclass
 class CycleResult:
     """Placements for one cycle. `assignments[i]` is the node name for

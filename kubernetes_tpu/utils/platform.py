@@ -1,0 +1,34 @@
+"""Backend/platform plumbing.
+
+This container's interpreter is armed with an axon TPU-relay site hook
+(sitecustomize via PYTHONPATH) that claims the TPU at interpreter start when
+PALLAS_AXON_POOL_IPS is set. If a process then asks for the CPU backend
+(JAX_PLATFORMS=cpu), jax backend init deadlocks against the half-initialized
+claim — the only reliable fix is a fresh interpreter with the hook disarmed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def ensure_cpu_backend_safe(argv: list[str] | None = None) -> None:
+    """Call BEFORE importing jax in any process that targets JAX_PLATFORMS=cpu.
+    Re-execs the interpreter once with the axon hook disarmed if needed."""
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        return
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return  # hook already disarmed
+    if os.environ.get("KTPU_CPU_REEXEC") == "1":
+        return  # already re-exec'd; don't loop
+    if "jax" in sys.modules:
+        sys.stderr.write(
+            "kubernetes_tpu: WARNING — jax already imported in an axon-armed "
+            "interpreter while targeting cpu; init may hang. Re-exec earlier.\n"
+        )
+        return
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["KTPU_CPU_REEXEC"] = "1"
+    os.execve(sys.executable, [sys.executable] + (argv or sys.argv), env)

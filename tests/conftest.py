@@ -1,14 +1,45 @@
-"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+"""Test configuration: force the CPU backend with 8 virtual devices.
 
 Mirrors the reference's test ladder (SURVEY.md §4): unit kernels and golden
-semantics tests run on the XLA CPU backend; multi-chip sharding tests use the
-8 virtual devices. Env must be set before jax imports."""
+semantics tests run on the XLA CPU backend ("XLA-on-CPU interpreter" rungs);
+multi-chip sharding tests use the 8 virtual devices. Set KTPU_TEST_TPU=1 to run
+the suite against the real chip instead.
+
+This interpreter may be armed with an axon TPU-relay site hook that deadlocks
+jax CPU-backend init (see kubernetes_tpu.utils.platform); switching to CPU
+needs a fresh process, so we re-exec pytest once with the hook disarmed — from
+pytest_configure, after stopping FD capture so the child inherits real stdio.
+"""
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_FORCE_CPU = os.environ.get("KTPU_TEST_TPU") != "1"
+
+if _FORCE_CPU:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _needs_reexec() -> bool:
+    return (
+        _FORCE_CPU
+        and bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+        and os.environ.get("KTPU_CPU_REEXEC") != "1"
+    )
+
+
+def pytest_configure(config):
+    if not _needs_reexec():
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # disarm the axon site hook
+    env["KTPU_CPU_REEXEC"] = "1"
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)

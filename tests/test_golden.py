@@ -1,0 +1,241 @@
+"""Golden tests: the tensor kernels vs the pure-Python semantics oracle.
+
+The reference validates predicates with table-driven unit tests
+(algorithm/predicates/predicates_test.go); we go further: thousands of
+randomized clusters, comparing the device Filter mask bit-for-bit against
+kubernetes_tpu.api.semantics on every (pod, node) pair.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import semantics as sem
+from kubernetes_tpu.api.types import (
+    Affinity,
+    HostPort,
+    LabelSelector,
+    Node,
+    NodeSelector,
+    NodeSelectorTerm,
+    Op,
+    Pod,
+    PodAffinityTerm,
+    Requirement,
+    Resources,
+    Taint,
+    TaintEffect,
+    Toleration,
+    TolerationOp,
+    TopologySpreadConstraint,
+    UnsatisfiableAction,
+)
+from kubernetes_tpu.sched.cycle import BatchScheduler, UNSCHEDULABLE_TAINT_KEY
+
+KEYS = ["app", "tier", "env", "disk", "gen"]
+VALS = ["web", "db", "cache", "prod", "dev", "ssd", "hdd", "", "3", "17"]
+ZONES = ["z-a", "z-b", "z-c"]
+EFFECTS = [TaintEffect.NO_SCHEDULE, TaintEffect.PREFER_NO_SCHEDULE, TaintEffect.NO_EXECUTE]
+
+
+def rand_labels(rng, max_n=3):
+    n = rng.randint(0, max_n)
+    keys = rng.sample(KEYS, min(n, len(KEYS)))
+    return {k: rng.choice(VALS) for k in keys}
+
+
+def rand_requirement(rng, node_side=False):
+    ops = [Op.IN, Op.NOT_IN, Op.EXISTS, Op.DOES_NOT_EXIST]
+    if node_side:
+        ops += [Op.GT, Op.LT]
+    op = rng.choice(ops)
+    key = rng.choice(KEYS)
+    if op in (Op.GT, Op.LT):
+        values = (rng.choice(["1", "5", "20", "abc"]),)
+    elif op in (Op.EXISTS, Op.DOES_NOT_EXIST):
+        values = ()
+    else:
+        values = tuple(rng.sample(VALS, rng.randint(1, 2)))
+    return Requirement(key, op, values)
+
+
+def rand_selector(rng):
+    return LabelSelector(tuple(rand_requirement(rng) for _ in range(rng.randint(0, 2))))
+
+
+def rand_node(rng, i):
+    labels = rand_labels(rng)
+    if rng.random() < 0.8:
+        labels["topology.kubernetes.io/zone"] = rng.choice(ZONES)
+    labels["kubernetes.io/hostname"] = f"n{i}"
+    taints = tuple(
+        Taint(rng.choice(KEYS), rng.choice(VALS), rng.choice(EFFECTS))
+        for _ in range(rng.randint(0, 2))
+    )
+    return Node(
+        name=f"n{i}",
+        labels=labels,
+        allocatable=Resources.make(
+            cpu=rng.choice(["1", "2", "4"]),
+            memory=rng.choice(["2Gi", "4Gi", "8Gi"]),
+            pods=rng.choice([2, 5, 110]),
+            scalars={"example.com/gpu": rng.randint(0, 4)} if rng.random() < 0.3 else None,
+        ),
+        taints=taints,
+        unschedulable=rng.random() < 0.1,
+    )
+
+
+def rand_toleration(rng):
+    if rng.random() < 0.15:
+        return Toleration(key="", op=TolerationOp.EXISTS)  # tolerate everything
+    return Toleration(
+        key=rng.choice(KEYS),
+        op=rng.choice([TolerationOp.EXISTS, TolerationOp.EQUAL]),
+        value=rng.choice(VALS),
+        effect=rng.choice(EFFECTS + [None]),
+    )
+
+
+def rand_pod(rng, i, bound_to=None):
+    affinity = Affinity()
+    if rng.random() < 0.3:
+        terms = tuple(
+            NodeSelectorTerm(tuple(rand_requirement(rng, node_side=True)
+                                   for _ in range(rng.randint(1, 2))))
+            for _ in range(rng.randint(1, 2))
+        )
+        affinity = Affinity(node_required=NodeSelector(terms))
+    pod_required = ()
+    anti_required = ()
+    if rng.random() < 0.35:
+        pod_required = tuple(
+            PodAffinityTerm(
+                selector=rand_selector(rng),
+                topology_key=rng.choice(["topology.kubernetes.io/zone", "kubernetes.io/hostname"]),
+            )
+            for _ in range(rng.randint(1, 2))
+        )
+    if rng.random() < 0.35:
+        anti_required = (
+            PodAffinityTerm(
+                selector=rand_selector(rng),
+                topology_key=rng.choice(["topology.kubernetes.io/zone", "kubernetes.io/hostname"]),
+            ),
+        )
+    affinity = Affinity(
+        node_required=affinity.node_required,
+        pod_required=pod_required,
+        anti_required=anti_required,
+    )
+    spread = ()
+    if rng.random() < 0.3:
+        spread = (
+            TopologySpreadConstraint(
+                max_skew=rng.randint(1, 2),
+                topology_key="topology.kubernetes.io/zone",
+                when_unsatisfiable=rng.choice(list(UnsatisfiableAction)),
+                selector=rand_selector(rng),
+            ),
+        )
+    ports = ()
+    if rng.random() < 0.25:
+        ports = (HostPort(rng.choice([80, 8080]), "TCP",
+                          rng.choice(["", "10.0.0.1"])),)
+    return Pod(
+        name=f"p{i}",
+        namespace=rng.choice(["default", "kube-system"]),
+        labels=rand_labels(rng),
+        requests=Resources.make(
+            cpu=rng.choice(["0", "100m", "500m", "2"]),
+            memory=rng.choice(["0", "128Mi", "1Gi"]),
+            scalars={"example.com/gpu": rng.randint(1, 2)} if rng.random() < 0.2 else None,
+        ),
+        node_selector=rand_labels(rng, 1) if rng.random() < 0.3 else {},
+        affinity=affinity,
+        tolerations=tuple(rand_toleration(rng) for _ in range(rng.randint(0, 2))),
+        topology_spread=spread,
+        host_ports=ports,
+        node_name=bound_to or "",
+        creation_index=i,
+    )
+
+
+def oracle_fits(pod, node, nodes, existing):
+    """The composed reference predicate chain (predicates.go predicatesOrdering
+    :138-144) for one (pod, node) pair against fixed existing pods."""
+    nodes_by_name = {n.name: n for n in nodes}
+    used = Resources()
+    used_pods = 0
+    used_ports = []
+    agg = {"cpu": 0, "mem": 0, "eph": 0, "scalars": {}}
+    for ex in existing:
+        if ex.node_name != node.name:
+            continue
+        used_pods += 1
+        agg["cpu"] += ex.requests.milli_cpu
+        agg["mem"] += ex.requests.memory_kib
+        agg["eph"] += ex.requests.ephemeral_kib
+        for k, v in ex.requests.scalars:
+            agg["scalars"][k] = agg["scalars"].get(k, 0) + v
+        used_ports.extend(ex.host_ports)
+    used = Resources(
+        milli_cpu=agg["cpu"], memory_kib=agg["mem"], ephemeral_kib=agg["eph"],
+        scalars=tuple(sorted(agg["scalars"].items())),
+    )
+    ok_res, _ = sem.pod_fits_resources(pod, node, used, used_pods)
+    return (
+        sem.check_node_unschedulable(pod, node)
+        and sem.pod_fits_host(pod, node)
+        and ok_res
+        and sem.pod_matches_node_selector(pod, node)
+        and sem.pod_fits_host_ports(pod, used_ports)
+        and sem.pod_tolerates_node_taints(pod, node)
+        and sem.interpod_affinity_fits(pod, node, nodes_by_name, existing)
+        and sem.topology_spread_fits(pod, node, nodes, existing)
+    )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_filter_mask_matches_oracle(seed):
+    rng = random.Random(seed)
+    n_nodes = rng.randint(2, 6)
+    nodes = [rand_node(rng, i) for i in range(n_nodes)]
+    existing = [
+        rand_pod(rng, 100 + i, bound_to=rng.choice(nodes).name)
+        for i in range(rng.randint(0, 8))
+    ]
+    pending = [rand_pod(rng, i) for i in range(rng.randint(1, 8))]
+
+    from kubernetes_tpu.sched.cycle import _feasible
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.state.dims import Dims
+
+    # generous shared capacities → one compile across all seeds
+    base = Dims(N=8, P=8, E=16, R=8, L=8, PL=4, NSE=2, T=2, PT=2, Q=4, V=4,
+                F=2, TL=4, TT=4, PP=2, AT=2, AN=2, PAT=2, PAN=2, TS=2,
+                S=64, SR=64, SL=64, SN=32, STL=16, SPP=8, SC=64, K=4, D=8)
+
+    sched = BatchScheduler()
+    enc = sched.encoder
+    enc.vocabs.label_keys.intern(UNSCHEDULABLE_TAINT_KEY)
+    enc.vocabs.label_vals.intern("")
+    tables, ex, pe, d = enc.encode_cluster(nodes, existing, pending, base)
+    uk = jnp.int32(enc.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+    ev = jnp.int32(enc.vocabs.label_vals.get(""))
+    got = np.asarray(
+        _feasible(jax.device_put(tables), jax.device_put(pe), (uk, ev), d.D,
+                  jax.device_put(ex))
+    )
+
+    for pi, pod in enumerate(pending):
+        for ni, node in enumerate(nodes):
+            want = oracle_fits(pod, node, nodes, existing)
+            assert got[pi, ni] == want, (
+                f"seed={seed} pod={pod.name} node={node.name}: "
+                f"device={bool(got[pi, ni])} oracle={want}\npod={pod}\nnode={node}"
+            )
