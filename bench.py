@@ -19,6 +19,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from kubernetes_tpu.utils.platform import ensure_cpu_backend_safe
+
+ensure_cpu_backend_safe()
+
 import jax
 
 from kubernetes_tpu.models.workloads import flagship_pods, make_nodes

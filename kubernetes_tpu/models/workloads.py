@@ -74,11 +74,11 @@ def density_pods(n: int, groups: int = 50, seed: int = 0) -> List[Pod]:
     return pods
 
 
-def flagship_pods(n: int, groups: int = 50, seed: int = 0) -> List[Pod]:
-    """Config-4 workload: every group spreads across zones (hard, maxSkew≥1);
-    a third of groups also anti-affine within racks; a third require affinity
-    to another group's pods in-zone (service co-location)."""
-    rng = random.Random(seed)
+def flagship_pods(n: int, groups: int = 50) -> List[Pod]:
+    """Config-4 workload, fully deterministic (no randomness by construction):
+    every group spreads across zones (hard, maxSkew≥1); a third of groups also
+    anti-affine within hosts; a third require affinity to another group's pods
+    in-zone (service co-location)."""
     pods = []
     per_group = max(n // groups, 1)
     for i in range(n):

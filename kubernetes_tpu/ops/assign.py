@@ -65,7 +65,6 @@ def assign_batch(
     nodes = tables.nodes
     classes = tables.classes
     terms = tables.terms
-    S = cyc.TM.shape[0]
     D = cyc.ELD.shape[2] - 1
 
     order = queue_order(pods)

@@ -18,19 +18,27 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..api.types import RES_PODS
+from ..api.types import NUM_FIXED_RES, RES_PODS
 from ..state.arrays import Array, NodeArrays, ReqTable
 
 MAX_NODE_SCORE = 100.0  # framework/v1alpha1/interface.go:87
 
 
 def _fit(vec: Array, free: Array) -> Array:
-    """vec: [..., R], free: [..., R] → [...] bool per PodFitsResources."""
+    """vec: [..., R], free: [..., R] → [...] bool per PodFitsResources.
+
+    Asymmetry of the reference (predicates.go:800-845): cpu/mem/ephemeral are
+    checked even when the pod requests 0 of them (0 > negative-free fails on an
+    overcommitted node), but *scalar* resources are only checked when requested
+    (Go iterates podRequest.ScalarResources), so a zero scalar request passes
+    regardless of that scalar's free. Oracle: api/semantics.py pod_fits_resources."""
     R = vec.shape[-1]
-    is_pods = jnp.arange(R) == RES_PODS
+    idx = jnp.arange(R)
+    is_pods = idx == RES_PODS
+    is_scalar = idx >= NUM_FIXED_RES
     pods_ok = (jnp.where(is_pods, vec, 0) <= jnp.where(is_pods, free, 0)).all(-1)
     zero_all = jnp.where(is_pods, 0, vec).max(-1) == 0
-    res_ok = (is_pods | (vec <= free)).all(-1)
+    res_ok = (is_pods | (is_scalar & (vec == 0)) | (vec <= free)).all(-1)
     return pods_ok & (zero_all | res_ok)
 
 

@@ -36,6 +36,13 @@ NODE_AXIS = "nodes"
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
+    if len(devs) < n:
+        raise RuntimeError(
+            f"make_mesh({n}): only {len(devs)} devices visible — a multichip "
+            "proof run on fewer devices than requested would validate nothing "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count and "
+            "JAX_PLATFORMS=cpu for a virtual mesh)"
+        )
     return Mesh(np.array(devs[:n]), (NODE_AXIS,))
 
 

@@ -1,0 +1,256 @@
+"""Scheduling queue: the 3-queue design of the reference's PriorityQueue
+(pkg/scheduler/internal/queue/scheduling_queue.go:119-138).
+
+  * activeQ    — heap ordered by (priority desc, creation asc): the pods the
+                 next cycle will take (activeQComp; pop at Pop()).
+  * backoffQ   — heap ordered by backoff expiry: pods that failed recently and
+                 must wait out an exponential backoff (1s initial, 10s max —
+                 scheduling_queue.go:60,64) before re-entering activeQ.
+  * unschedulableQ — map of pods that found no feasible node; they re-enter
+                 activeQ when a cluster event might have made them schedulable
+                 (MoveAllToActiveQueue, eventhandlers.go:392-441) or after the
+                 60s flush (unschedulableQTimeInterval, scheduling_queue.go:51).
+
+Differences from the reference, by design:
+  * No background goroutines. The reference pumps flushBackoffQCompleted every
+    1s and flushUnschedulableQLeftover every 30s (scheduling_queue.go:252-253);
+    here `pump(now)` does both with an injected clock — the scheduling loop
+    calls it once per cycle, and tests drive time explicitly.
+  * Batch pop: `pop_batch(max_n)` drains up to max_n pods in comparator order,
+    because the TPU backend schedules a whole wave per device dispatch instead
+    of one pod per loop iteration (scheduler.go:596 scheduleOne).
+
+The nominated-pods map (scheduling_queue.go:136-138, preemption's "I will fit
+once the victims die" bookkeeping) lives here too, as in the reference.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api.types import Pod
+
+INITIAL_BACKOFF = 1.0            # podInitialBackoffDuration, scheduling_queue.go:60
+MAX_BACKOFF = 10.0               # podMaxBackoffDuration, scheduling_queue.go:64
+UNSCHEDULABLE_FLUSH_INTERVAL = 60.0  # unschedulableQTimeInterval, :51
+
+
+@dataclass
+class _Entry:
+    pod: Pod
+    attempts: int = 0           # scheduling failures so far (backoff exponent)
+    timestamp: float = 0.0      # last time the pod entered a queue
+
+
+def _active_key(e: _Entry) -> Tuple[int, int]:
+    """activeQComp: higher priority first, then earlier creation."""
+    return (-e.pod.priority, e.pod.creation_index)
+
+
+class PriorityQueue:
+    """Thread-safe. All mutation under one lock, as the reference's `p.lock`."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._cond = threading.Condition(self._mu)
+        self._seq = itertools.count()
+        # heaps hold (key..., seq, entry); maps give O(1) membership
+        self._active: List[Tuple[int, int, int, _Entry]] = []
+        self._active_keys: Dict[str, _Entry] = {}
+        self._backoff: List[Tuple[float, int, _Entry]] = []
+        self._backoff_keys: Dict[str, _Entry] = {}
+        self._unschedulable: Dict[str, _Entry] = {}
+        self._nominated: Dict[str, str] = {}  # pod key -> nominated node name
+        # schedulingCycle / moveRequestCycle (scheduling_queue.go:139-147):
+        # if a move request happened at-or-after the cycle a pod was popped in,
+        # its failure verdict is stale — retry via backoffQ, not unschedulableQ.
+        self._cycle = 0
+        self._move_cycle = -1
+
+    # ------------------------------------------------------------------ #
+    # membership helpers
+    # ------------------------------------------------------------------ #
+
+    def _delete_everywhere(self, key: str) -> Optional[_Entry]:
+        e = self._active_keys.pop(key, None)
+        if e is None:
+            e = self._backoff_keys.pop(key, None)
+        if e is None:
+            e = self._unschedulable.pop(key, None)
+        # heap entries are lazily discarded at pop time via the key maps
+        return e
+
+    def _push_active(self, e: _Entry) -> None:
+        k = _active_key(e)
+        heapq.heappush(self._active, (k[0], k[1], next(self._seq), e))
+        self._active_keys[e.pod.key] = e
+        self._cond.notify_all()
+
+    # ------------------------------------------------------------------ #
+    # public API (scheduling_queue.go Add/AddUnschedulableIfNotPresent/
+    # Pop/Update/Delete/MoveAllToActiveQueue)
+    # ------------------------------------------------------------------ #
+
+    def add(self, pod: Pod, now: float = 0.0) -> None:
+        """Add a new pending pod straight to activeQ."""
+        with self._mu:
+            self._delete_everywhere(pod.key)
+            self._push_active(_Entry(pod=pod, timestamp=now))
+
+    def add_unschedulable(
+        self, pod: Pod, attempts: int, now: float, cycle: Optional[int] = None
+    ) -> None:
+        """AddUnschedulableIfNotPresent (scheduling_queue.go:287): a pod that
+        just failed. If a move request arrived at-or-after the cycle the pod
+        was popped in (cluster state changed mid-flight), it goes to backoffQ
+        for a prompt retry instead of parking in unschedulableQ."""
+        with self._mu:
+            if pod.key in self._active_keys or pod.key in self._backoff_keys:
+                return
+            e = _Entry(pod=pod, attempts=attempts, timestamp=now)
+            popped_cycle = self._cycle if cycle is None else cycle
+            if self._move_cycle >= popped_cycle:
+                heapq.heappush(
+                    self._backoff, (now + self._backoff_for(e), next(self._seq), e)
+                )
+                self._backoff_keys[pod.key] = e
+            else:
+                self._unschedulable[pod.key] = e
+
+    def _backoff_for(self, e: _Entry) -> float:
+        return self.backoff_duration(e.attempts)
+
+    @staticmethod
+    def backoff_duration(attempts: int) -> float:
+        """Exponential: 1s * 2^(attempts-1) capped at 10s (getBackoffTime,
+        scheduling_queue.go:60-64)."""
+        return min(INITIAL_BACKOFF * (2.0 ** max(attempts - 1, 0)), MAX_BACKOFF)
+
+    def update(self, pod: Pod, now: float = 0.0) -> None:
+        """Update (scheduling_queue.go:331): spec changes reset the pod's
+        queue position; an unschedulable pod whose spec changed may now fit,
+        so it moves to activeQ."""
+        with self._mu:
+            e = self._delete_everywhere(pod.key)
+            attempts = e.attempts if e else 0
+            self._push_active(_Entry(pod=pod, attempts=attempts, timestamp=now))
+
+    def delete(self, key: str) -> None:
+        with self._mu:
+            self._delete_everywhere(key)
+            self._nominated.pop(key, None)
+
+    def pop_batch(self, max_n: int, now: float = 0.0) -> List[Tuple[Pod, int]]:
+        """Drain up to max_n pods from activeQ in comparator order. Returns
+        (pod, attempts) pairs; attempts feeds the next backoff on failure."""
+        out: List[Tuple[Pod, int]] = []
+        with self._mu:
+            self._cycle += 1
+            while self._active and len(out) < max_n:
+                _, _, _, e = heapq.heappop(self._active)
+                if self._active_keys.get(e.pod.key) is not e:
+                    continue  # stale heap entry
+                del self._active_keys[e.pod.key]
+                e.attempts += 1
+                out.append((e.pod, e.attempts))
+        return out
+
+    def pop_blocking(self, timeout: Optional[float] = None) -> Optional[Tuple[Pod, int]]:
+        """Pop one pod, blocking like the reference's Pop (scheduling_queue.go
+        Pop blocks on a condition variable until activeQ is non-empty)."""
+        with self._mu:
+            while not self._active:
+                if not self._cond.wait(timeout):
+                    return None
+            self._cycle += 1
+            batch = None
+            while self._active:
+                _, _, _, e = heapq.heappop(self._active)
+                if self._active_keys.get(e.pod.key) is not e:
+                    continue
+                del self._active_keys[e.pod.key]
+                e.attempts += 1
+                batch = (e.pod, e.attempts)
+                break
+            return batch
+
+    def move_all_to_active(self, now: float = 0.0) -> int:
+        """MoveAllToActiveQueue (scheduling_queue.go:358): a cluster event
+        (node add, PV create, …) may have unblocked anything — move the whole
+        unschedulableQ to activeQ/backoffQ and bump the move counter."""
+        with self._mu:
+            self._move_cycle = self._cycle
+            n = len(self._unschedulable)
+            for key, e in list(self._unschedulable.items()):
+                del self._unschedulable[key]
+                remaining = self._backoff_for(e) - (now - e.timestamp)
+                if remaining > 0:
+                    heapq.heappush(
+                        self._backoff, (e.timestamp + self._backoff_for(e),
+                                        next(self._seq), e)
+                    )
+                    self._backoff_keys[key] = e
+                else:
+                    self._push_active(e)
+            return n
+
+    def pump(self, now: float) -> None:
+        """flushBackoffQCompleted + flushUnschedulableQLeftover
+        (scheduling_queue.go:252-253, 1s/30s background pumps)."""
+        with self._mu:
+            # backoff → active
+            while self._backoff:
+                expiry, _, e = self._backoff[0]
+                if expiry > now:
+                    break
+                heapq.heappop(self._backoff)
+                if self._backoff_keys.get(e.pod.key) is not e:
+                    continue
+                del self._backoff_keys[e.pod.key]
+                self._push_active(e)
+            # stale unschedulable → active (60s)
+            for key, e in list(self._unschedulable.items()):
+                if now - e.timestamp >= UNSCHEDULABLE_FLUSH_INTERVAL:
+                    del self._unschedulable[key]
+                    self._push_active(e)
+
+    # ------------------------------------------------------------------ #
+    # nominated pods (preemption bookkeeping, scheduling_queue.go:136-138)
+    # ------------------------------------------------------------------ #
+
+    def add_nominated(self, pod_key: str, node_name: str) -> None:
+        with self._mu:
+            self._nominated[pod_key] = node_name
+
+    def delete_nominated(self, pod_key: str) -> None:
+        with self._mu:
+            self._nominated.pop(pod_key, None)
+
+    def nominated_on(self, node_name: str) -> List[str]:
+        with self._mu:
+            return [k for k, n in self._nominated.items() if n == node_name]
+
+    def nominated_node(self, pod_key: str) -> Optional[str]:
+        with self._mu:
+            return self._nominated.get(pod_key)
+
+    # ------------------------------------------------------------------ #
+    # introspection
+    # ------------------------------------------------------------------ #
+
+    def current_cycle(self) -> int:
+        """The scheduling-cycle counter of the most recent pop — callers pass
+        this back into add_unschedulable for the moveRequestCycle comparison."""
+        with self._mu:
+            return self._cycle
+
+    def lengths(self) -> Tuple[int, int, int]:
+        """(active, backoff, unschedulable) — the pending-pods queue-depth
+        recorders (scheduling_queue.go:237-243)."""
+        with self._mu:
+            return (len(self._active_keys), len(self._backoff_keys),
+                    len(self._unschedulable))

@@ -1,0 +1,219 @@
+"""The stateful, watch-driven scheduler: cache + queue + batched device cycle.
+
+This is the analog of the reference's Scheduler struct and its wiring
+(pkg/scheduler/scheduler.go:79-122, eventhandlers.go:335-441), with the
+per-pod scheduleOne loop (scheduler.go:596-763) replaced by a per-*wave*
+batched cycle: pop up to `batch_size` pods, one device dispatch schedules all
+of them with sequential assume semantics (ops/assign.py lax.scan), then commit.
+
+Event handlers mirror eventhandlers.go:
+  * assigned-pod add/update/delete      → cache            (:360-362)
+  * unassigned-pod add/update/delete    → queue            (:367-385, filtered
+    by `responsible_for` — the schedulerName check, :277-282)
+  * node add/update/delete              → cache + queue.move_all_to_active
+                                                           (:392-396)
+Failures feed the backoff/unschedulable queues exactly as FitError handling
+does (scheduler.go:436-448); bind errors roll back via cache.forget_pod
+(scheduler.go:717,732).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Protocol, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..api.types import DEFAULT_SCHEDULER_NAME, Node, Pod
+from ..state.cache import SchedulerCache, Snapshot
+from ..state.dims import Dims
+from ..state.encode import Encoder
+from .cycle import UNSCHEDULABLE_TAINT_KEY, _schedule_batch
+from .queue import PriorityQueue
+
+
+class Binder(Protocol):
+    """The Binding write (scheduler.go:565 b.Client.CoreV1().Pods(...).Bind).
+    Returns True on success; False/raise → rollback via ForgetPod."""
+
+    def bind(self, pod: Pod, node_name: str) -> bool: ...
+
+
+class RecordingBinder:
+    """Test binder in the spirit of the fake clientset: records bindings and
+    optionally fails selected pods."""
+
+    def __init__(self, fail_keys: Sequence[str] = ()) -> None:
+        self.bound: List[Tuple[str, str]] = []
+        self.fail_keys = set(fail_keys)
+
+    def bind(self, pod: Pod, node_name: str) -> bool:
+        if pod.key in self.fail_keys:
+            return False
+        self.bound.append((pod.key, node_name))
+        return True
+
+
+@dataclass
+class CycleStats:
+    """Per-wave outcome; feeds the scheduling metrics
+    (metrics/metrics.go:32-99)."""
+
+    attempted: int = 0
+    scheduled: int = 0
+    unschedulable: int = 0
+    bind_errors: int = 0
+    cycle_seconds: float = 0.0
+    assignments: Dict[str, str] = field(default_factory=dict)
+
+
+class Scheduler:
+    """Single-profile scheduler. `schedule_pending` is the wave analog of
+    scheduleOne; call it from a loop (or `run_until_idle`)."""
+
+    def __init__(
+        self,
+        binder: Binder,
+        cache: Optional[SchedulerCache] = None,
+        queue: Optional[PriorityQueue] = None,
+        scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+        batch_size: int = 4096,
+        base_dims: Optional[Dims] = None,
+        clock: Callable[[], float] = time.monotonic,
+        preemptor: Optional["object"] = None,
+    ) -> None:
+        self.binder = binder
+        self.cache = cache or SchedulerCache()
+        self.queue = queue or PriorityQueue()
+        self.scheduler_name = scheduler_name
+        self.batch_size = batch_size
+        self.base_dims = base_dims
+        self.clock = clock
+        self.encoder = Encoder()
+        self.preemptor = preemptor  # set by sched.preemption.attach()
+
+    # ------------------------------------------------------------------ #
+    # event handlers (eventhandlers.go)
+    # ------------------------------------------------------------------ #
+
+    def responsible_for(self, pod: Pod) -> bool:
+        """responsibleForPod (eventhandlers.go:282)."""
+        return pod.scheduler_name == self.scheduler_name
+
+    def on_pod_add(self, pod: Pod) -> None:
+        if pod.node_name:                       # assignedPod (:277)
+            if self.cache.is_assumed(pod.key) or self.cache.get_pod(pod.key) is None:
+                self.cache.add_pod(pod)
+            # a new pod landing may unblock anti-affinity waiters etc.
+            self.queue.move_all_to_active(self.clock())
+        elif self.responsible_for(pod):
+            self.queue.add(pod, now=self.clock())
+
+    def on_pod_update(self, old: Pod, new: Pod) -> None:
+        if new.node_name:
+            if self.cache.get_pod(new.key) is not None and not self.cache.is_assumed(new.key):
+                self.cache.update_pod(new)
+            else:
+                self.cache.add_pod(new)
+        elif self.responsible_for(new):
+            self.queue.update(new, now=self.clock())
+
+    def on_pod_delete(self, pod: Pod) -> None:
+        if pod.node_name:
+            if self.cache.get_pod(pod.key) is not None:
+                self.cache.remove_pod(pod.key)
+            # freed resources may unblock pending pods (eventhandlers.go:222)
+            self.queue.move_all_to_active(self.clock())
+        else:
+            self.queue.delete(pod.key)
+
+    def on_node_add(self, node: Node) -> None:
+        self.cache.add_node(node)
+        self.queue.move_all_to_active(self.clock())
+
+    def on_node_update(self, node: Node) -> None:
+        self.cache.update_node(node)
+        self.queue.move_all_to_active(self.clock())
+
+    def on_node_delete(self, name: str) -> None:
+        self.cache.remove_node(name)
+
+    # ------------------------------------------------------------------ #
+    # the scheduling wave
+    # ------------------------------------------------------------------ #
+
+    def schedule_pending(self, now: Optional[float] = None) -> CycleStats:
+        """One wave: pump → pop batch → snapshot → device cycle → commit.
+
+        Sequential assume semantics hold *within* the wave (the device scan
+        carries the assume-state pod to pod) and *across* waves (assumed pods
+        are in cache.scheduled_pods() for the next snapshot)."""
+        now = self.clock() if now is None else now
+        t0 = time.perf_counter()
+        self.queue.pump(now)
+        self.cache.cleanup(now)
+        batch = self.queue.pop_batch(self.batch_size, now=now)
+        cycle = self.queue.current_cycle()
+        stats = CycleStats(attempted=len(batch))
+        if not batch:
+            return stats
+
+        pending = [p for p, _ in batch]
+        snap = self.cache.snapshot(
+            self.encoder, pending, self.base_dims,
+            extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
+        )
+        self.encoder.vocabs.label_vals.intern("")
+        uk = jnp.int32(self.encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+        ev = jnp.int32(self.encoder.vocabs.label_vals.get(""))
+        res = _schedule_batch(snap.tables, snap.pending, (uk, ev), snap.dims.D,
+                              snap.existing)
+        node_idx = jax.device_get(res.node)
+
+        for i, (pod, attempts) in enumerate(batch):
+            ni = int(node_idx[i])
+            if ni < 0:
+                handled = False
+                if self.preemptor is not None:
+                    handled = self.preemptor.try_preempt(self, pod, snap, now)
+                if not handled:
+                    stats.unschedulable += 1
+                    self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
+                continue
+            node_name = snap.node_order[ni]
+            self.cache.assume_pod(pod, node_name)
+            self.queue.delete_nominated(pod.key)
+            ok = False
+            try:
+                ok = self.binder.bind(pod, node_name)
+            except Exception:
+                ok = False
+            if ok:
+                self.cache.finish_binding(pod.key, now)
+                stats.scheduled += 1
+                stats.assignments[pod.key] = node_name
+            else:
+                # rollback + retry (scheduler.go:717,732 → ForgetPod)
+                self.cache.forget_pod(pod.key)
+                stats.bind_errors += 1
+                self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
+
+        stats.cycle_seconds = time.perf_counter() - t0
+        return stats
+
+    def run_until_idle(self, max_waves: int = 100) -> CycleStats:
+        """Drive waves until the active queue drains (integration-test helper;
+        the production loop is wait.Until(scheduleOne) — scheduler.go:425-431)."""
+        total = CycleStats()
+        for _ in range(max_waves):
+            s = self.schedule_pending()
+            total.attempted += s.attempted
+            total.scheduled += s.scheduled
+            total.unschedulable += s.unschedulable
+            total.bind_errors += s.bind_errors
+            total.assignments.update(s.assignments)
+            if self.queue.lengths()[0] == 0:
+                break
+        return total

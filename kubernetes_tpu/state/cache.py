@@ -1,0 +1,294 @@
+"""Scheduler cache: the host-side mirror of cluster state with the
+assume/confirm/expire pod lifecycle and generation-diffed snapshots.
+
+Mirrors the semantics of the reference's schedulerCache
+(pkg/scheduler/internal/cache/cache.go):
+
+  * AssumePod / FinishBinding / ForgetPod  (cache.go:283,304,328) — optimistic
+    commit: the scheduler marks a pod as placed *before* the API write lands so
+    the next cycle sees its resources; a TTL reaps assumed pods whose bind
+    confirmation never arrives (expiry goroutine, cache.go:634-667 — here an
+    explicit `cleanup(now)` with an injected clock, testable without sleeping).
+  * AddPod confirms an assumed pod (cache.go:394-427); Update/RemovePod keep
+    the mirror in sync with informer events (cache.go:429-517).
+  * Add/Update/RemoveNode (cache.go:519-567).
+  * UpdateNodeInfoSnapshot (cache.go:204-255): the reference walks a
+    generation-ordered doubly-linked list of NodeInfos and copies only nodes
+    whose generation is newer than the snapshot's. Here the same contract is a
+    single monotonic `generation` plus per-node generations: `snapshot()`
+    returns a cached `Snapshot` untouched when nothing changed, and re-encodes
+    (host numpy staging → one device transfer) only when the generation moved.
+    Unlike the reference there is no per-node copy loop to optimize away — the
+    expensive artifact is the device-resident array set, rebuilt at most once
+    per generation bump and reused across cycles with identical pending sets.
+
+The reference's node_tree (internal/cache/node_tree.go:147 zone round-robin
+iterator) has no analog here by design: it exists to spread *sampled* node
+subsets across zones, and the TPU path evaluates the full (class × node)
+lattice — spreading is handled by the PodTopologySpread scores natively
+(SURVEY §2.3 "zone-balanced iteration").
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..api.types import Node, Pod
+from .dims import Dims
+from .encode import Encoder
+
+
+DEFAULT_ASSUME_TTL = 30.0  # durationToExpireAssumedPod, scheduler.go:268 (30s)
+
+
+@dataclass
+class _PodState:
+    """podState (cache.go:52-58): the pod plus its assume bookkeeping."""
+
+    pod: Pod
+    assumed: bool = False
+    binding_finished: bool = False
+    deadline: Optional[float] = None  # set by finish_binding; None = no expiry
+
+
+class CacheError(RuntimeError):
+    """Raised on lifecycle violations the reference treats as logic errors
+    (cache.go returns errors / Fatalf on cache corruption, cache.go:445,473)."""
+
+
+@dataclass
+class Snapshot:
+    """An immutable per-cycle view (nodeinfo/snapshot/snapshot.go): encoded
+    device tables + the node-name order they were built in + the generation
+    they reflect."""
+
+    generation: int
+    node_order: List[str]
+    tables: object            # ClusterTables (device)
+    existing: object          # PodArrays (device)
+    pending: object           # PodArrays (device)
+    dims: Dims
+    pending_keys: Tuple[Tuple[str, int], ...]  # (pod key, object identity)
+
+
+class SchedulerCache:
+    """Thread-safe pod/node mirror. A single writer (the event-handler thread)
+    and a single reader (the scheduling loop) is the expected pattern, matching
+    the reference's `cache.mu` discipline."""
+
+    def __init__(self, ttl: float = DEFAULT_ASSUME_TTL) -> None:
+        self._mu = threading.Lock()
+        self._ttl = ttl
+        self._nodes: Dict[str, Node] = {}
+        self._pods: Dict[str, _PodState] = {}
+        self._generation = 0
+        self._snapshot: Optional[Snapshot] = None
+
+    # ------------------------------------------------------------------ #
+    # pod lifecycle (cache.go:283-517)
+    # ------------------------------------------------------------------ #
+
+    def assume_pod(self, pod: Pod, node_name: str) -> None:
+        """AssumePod (cache.go:283): optimistic placement of a scheduled pod."""
+        with self._mu:
+            key = pod.key
+            if key in self._pods:
+                raise CacheError(f"pod {key} is already in the cache")
+            p = replace(pod, node_name=node_name)
+            self._pods[key] = _PodState(pod=p, assumed=True)
+            self._generation += 1
+
+    def finish_binding(self, key: str, now: float) -> None:
+        """FinishBinding (cache.go:304): the async bind goroutine completed its
+        API write; start the expiry clock in case the confirming informer event
+        never arrives."""
+        with self._mu:
+            st = self._pods.get(key)
+            if st is None or not st.assumed:
+                return  # finished binding for a pod no longer assumed: no-op
+            st.binding_finished = True
+            st.deadline = now + self._ttl
+
+    def forget_pod(self, key: str) -> None:
+        """ForgetPod (cache.go:328): bind/permit/volume failure rollback."""
+        with self._mu:
+            st = self._pods.get(key)
+            if st is None:
+                return
+            if not st.assumed:
+                raise CacheError(f"pod {key} is bound, cannot forget")
+            del self._pods[key]
+            self._generation += 1
+
+    def add_pod(self, pod: Pod) -> None:
+        """AddPod (cache.go:394): informer confirmation. Confirms an assumed
+        pod (clears its deadline) or inserts a pod scheduled by someone else."""
+        with self._mu:
+            key = pod.key
+            st = self._pods.get(key)
+            if st is not None and st.assumed:
+                # confirmation — possibly onto a different node than assumed
+                # (cache.go:404-410 logs and corrects)
+                self._pods[key] = _PodState(pod=pod)
+            elif st is None:
+                self._pods[key] = _PodState(pod=pod)
+            else:
+                raise CacheError(f"pod {key} was already added")
+            self._generation += 1
+
+    def update_pod(self, pod: Pod) -> None:
+        """UpdatePod (cache.go:429). Assumed pods are not updatable — the
+        reference treats an update event for an assumed pod as corruption."""
+        with self._mu:
+            st = self._pods.get(pod.key)
+            if st is None or st.assumed:
+                raise CacheError(f"pod {pod.key} is not bound in the cache")
+            st.pod = pod
+            self._generation += 1
+
+    def remove_pod(self, key: str) -> None:
+        """RemovePod (cache.go:457)."""
+        with self._mu:
+            st = self._pods.get(key)
+            if st is None:
+                raise CacheError(f"pod {key} is not in the cache")
+            del self._pods[key]
+            self._generation += 1
+
+    def is_assumed(self, key: str) -> bool:
+        with self._mu:
+            st = self._pods.get(key)
+            return bool(st and st.assumed)
+
+    def get_pod(self, key: str) -> Optional[Pod]:
+        with self._mu:
+            st = self._pods.get(key)
+            return st.pod if st else None
+
+    # ------------------------------------------------------------------ #
+    # node lifecycle (cache.go:519-567)
+    # ------------------------------------------------------------------ #
+
+    def add_node(self, node: Node) -> None:
+        with self._mu:
+            self._nodes[node.name] = node
+            self._generation += 1
+
+    def update_node(self, node: Node) -> None:
+        with self._mu:
+            self._nodes[node.name] = node
+            self._generation += 1
+
+    def remove_node(self, name: str) -> None:
+        with self._mu:
+            if name not in self._nodes:
+                raise CacheError(f"node {name} is not in the cache")
+            del self._nodes[name]
+            self._generation += 1
+
+    # ------------------------------------------------------------------ #
+    # expiry (cache.go:634-667)
+    # ------------------------------------------------------------------ #
+
+    def cleanup(self, now: float) -> List[str]:
+        """cleanupAssumedPods: drop assumed pods whose bind finished but whose
+        confirming watch event never arrived within the TTL. Returns the
+        expired keys (the reference logs a warning per pod, cache.go:657)."""
+        expired: List[str] = []
+        with self._mu:
+            for key, st in list(self._pods.items()):
+                if st.assumed and st.binding_finished and st.deadline is not None \
+                        and now >= st.deadline:
+                    del self._pods[key]
+                    expired.append(key)
+            if expired:
+                self._generation += 1
+        return expired
+
+    # ------------------------------------------------------------------ #
+    # snapshot (cache.go:204-255)
+    # ------------------------------------------------------------------ #
+
+    def scheduled_pods(self) -> List[Pod]:
+        """All pods occupying node resources: bound + assumed."""
+        with self._mu:
+            return [st.pod for st in self._pods.values()]
+
+    def nodes(self) -> List[Node]:
+        with self._mu:
+            return list(self._nodes.values())
+
+    @property
+    def generation(self) -> int:
+        with self._mu:
+            return self._generation
+
+    def counts(self) -> Tuple[int, int, int]:
+        """(nodes, total pods, assumed pods) — the cache-size gauges
+        (cache.go:692-696)."""
+        with self._mu:
+            assumed = sum(1 for s in self._pods.values() if s.assumed)
+            return len(self._nodes), len(self._pods), assumed
+
+    def snapshot(
+        self,
+        encoder: Encoder,
+        pending: Sequence[Pod],
+        base_dims: Optional[Dims] = None,
+        extra_intern: Sequence[str] = (),
+    ) -> Snapshot:
+        """UpdateNodeInfoSnapshot analog: return the cached encoded view if
+        neither the cluster state (generation) nor the pending set changed;
+        otherwise re-encode and transfer once.
+
+        The pending signature includes object identity, not just pod keys: a
+        spec update flows through the queue as a *new* Pod object with the same
+        namespace/name (queue.update), and scheduling it against the cached
+        encoding of the old spec would pin it unschedulable forever."""
+        pending_keys = tuple((p.key, id(p)) for p in pending)
+        with self._mu:
+            gen = self._generation
+            snap = self._snapshot
+            if snap is not None and snap.generation == gen \
+                    and snap.pending_keys == pending_keys:
+                return snap
+            nodes = list(self._nodes.values())
+            existing = [st.pod for st in self._pods.values()]
+
+        for s in extra_intern:
+            encoder.vocabs.label_keys.intern(s)
+        tables, ex, pe, d = encoder.encode_cluster(
+            nodes, existing, list(pending), base_dims
+        )
+        snap = Snapshot(
+            generation=gen,
+            node_order=[n.name for n in nodes],
+            tables=jax.device_put(tables),
+            existing=jax.device_put(ex),
+            pending=jax.device_put(pe),
+            dims=d,
+            pending_keys=pending_keys,
+        )
+        with self._mu:
+            self._snapshot = snap
+        return snap
+
+
+class FakeCache(SchedulerCache):
+    """Test double in the spirit of internal/cache/fake/fake_cache.go — a real
+    cache with a controllable clock convenience."""
+
+    def expire_all_assumed(self) -> List[str]:
+        with self._mu:
+            expired = [k for k, s in self._pods.items()
+                       if s.assumed and s.binding_finished]
+            for k in expired:
+                del self._pods[k]
+            if expired:
+                self._generation += 1
+        return expired

@@ -22,12 +22,10 @@ def ensure_cpu_backend_safe(argv: list[str] | None = None) -> None:
         return  # hook already disarmed
     if os.environ.get("KTPU_CPU_REEXEC") == "1":
         return  # already re-exec'd; don't loop
-    if "jax" in sys.modules:
-        sys.stderr.write(
-            "kubernetes_tpu: WARNING — jax already imported in an axon-armed "
-            "interpreter while targeting cpu; init may hang. Re-exec earlier.\n"
-        )
-        return
+    # NB: "jax already imported" is the NORMAL armed case — the site hook
+    # imports jax at interpreter start, before any user code could run. The
+    # re-exec'd child is a fresh process with the hook disarmed, so re-exec
+    # is exactly as safe here as before the import.
     env = dict(os.environ)
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["KTPU_CPU_REEXEC"] = "1"

@@ -1,0 +1,185 @@
+"""Lifecycle tests for the scheduler cache and the 3-queue PriorityQueue,
+mirroring the table-driven cases of internal/cache/cache_test.go and
+internal/queue/scheduling_queue_test.go."""
+
+import pytest
+
+from kubernetes_tpu.api.types import Node, Pod, Resources
+from kubernetes_tpu.sched.queue import (
+    INITIAL_BACKOFF,
+    MAX_BACKOFF,
+    UNSCHEDULABLE_FLUSH_INTERVAL,
+    PriorityQueue,
+)
+from kubernetes_tpu.state.cache import CacheError, SchedulerCache
+from kubernetes_tpu.state.encode import Encoder
+
+
+def pod(name, priority=0, creation=0):
+    return Pod(name=name, priority=priority, creation_index=creation,
+               requests=Resources.make(cpu="100m", memory="64Mi"))
+
+
+class TestSchedulerCache:
+    def test_assume_confirm_lifecycle(self):
+        c = SchedulerCache(ttl=30.0)
+        c.add_node(Node(name="n1", allocatable=Resources.make(cpu=4, memory="8Gi")))
+        p = pod("a")
+        c.assume_pod(p, "n1")
+        assert c.is_assumed("default/a")
+        assert c.get_pod("default/a").node_name == "n1"
+        # informer confirmation clears assumed
+        bound = pod("a")
+        bound.node_name = "n1"
+        c.add_pod(bound)
+        assert not c.is_assumed("default/a")
+        assert c.counts() == (1, 1, 0)
+
+    def test_assume_expire(self):
+        c = SchedulerCache(ttl=30.0)
+        p = pod("a")
+        c.assume_pod(p, "n1")
+        c.finish_binding("default/a", now=100.0)
+        assert c.cleanup(now=129.0) == []          # not yet
+        assert c.cleanup(now=130.0) == ["default/a"]
+        assert c.get_pod("default/a") is None
+
+    def test_unfinished_binding_never_expires(self):
+        c = SchedulerCache(ttl=30.0)
+        c.assume_pod(pod("a"), "n1")
+        assert c.cleanup(now=1e9) == []  # no FinishBinding → no deadline
+
+    def test_forget_pod(self):
+        c = SchedulerCache()
+        c.assume_pod(pod("a"), "n1")
+        c.forget_pod("default/a")
+        assert c.get_pod("default/a") is None
+        # forgetting a bound pod is a lifecycle violation
+        bound = pod("b")
+        bound.node_name = "n1"
+        c.add_pod(bound)
+        with pytest.raises(CacheError):
+            c.forget_pod("default/b")
+
+    def test_double_assume_rejected(self):
+        c = SchedulerCache()
+        c.assume_pod(pod("a"), "n1")
+        with pytest.raises(CacheError):
+            c.assume_pod(pod("a"), "n2")
+
+    def test_generation_moves_only_on_change(self):
+        c = SchedulerCache()
+        g0 = c.generation
+        c.add_node(Node(name="n1"))
+        g1 = c.generation
+        assert g1 > g0
+        c.cleanup(now=0.0)  # nothing expired → no bump
+        assert c.generation == g1
+
+    def test_snapshot_cached_until_generation_moves(self):
+        c = SchedulerCache()
+        c.add_node(Node(name="n1", allocatable=Resources.make(cpu=4, memory="8Gi")))
+        enc = Encoder()
+        pend = [pod("p1")]
+        s1 = c.snapshot(enc, pend)
+        s2 = c.snapshot(enc, pend)
+        assert s1 is s2                       # no change → same object
+        c.add_node(Node(name="n2", allocatable=Resources.make(cpu=4, memory="8Gi")))
+        s3 = c.snapshot(enc, pend)
+        assert s3 is not s2
+        assert s3.node_order == ["n1", "n2"]
+
+    def test_snapshot_recomputed_on_pending_change(self):
+        c = SchedulerCache()
+        c.add_node(Node(name="n1", allocatable=Resources.make(cpu=4, memory="8Gi")))
+        enc = Encoder()
+        s1 = c.snapshot(enc, [pod("p1")])
+        s2 = c.snapshot(enc, [pod("p2")])
+        assert s1 is not s2
+
+
+class TestPriorityQueue:
+    def test_pop_order_priority_then_creation(self):
+        q = PriorityQueue()
+        q.add(pod("low", priority=0, creation=0))
+        q.add(pod("high", priority=10, creation=5))
+        q.add(pod("mid-old", priority=5, creation=1))
+        q.add(pod("mid-new", priority=5, creation=2))
+        got = [p.name for p, _ in q.pop_batch(10)]
+        assert got == ["high", "mid-old", "mid-new", "low"]
+
+    def test_unschedulable_waits_for_move(self):
+        q = PriorityQueue()
+        q.add(pod("a"))
+        (p, attempts), = q.pop_batch(1, now=0.0)
+        q.add_unschedulable(p, attempts, now=0.0)
+        assert q.lengths() == (0, 0, 1)
+        q.pump(now=5.0)
+        assert q.lengths() == (0, 0, 1)       # no event, still parked
+        q.move_all_to_active(now=5.0)
+        assert q.lengths() == (1, 0, 0)       # backoff (1s) already elapsed
+
+    def test_move_respects_remaining_backoff(self):
+        q = PriorityQueue()
+        q.add(pod("a"))
+        (p, attempts), = q.pop_batch(1, now=0.0)
+        q.add_unschedulable(p, attempts, now=0.0)
+        q.move_all_to_active(now=0.5)         # 1s backoff not yet elapsed
+        assert q.lengths() == (0, 1, 0)
+        q.pump(now=0.9)
+        assert q.lengths() == (0, 1, 0)
+        q.pump(now=1.1)
+        assert q.lengths() == (1, 0, 0)
+
+    def test_exponential_backoff_caps_at_max(self):
+        assert PriorityQueue.backoff_duration(1) == INITIAL_BACKOFF
+        assert PriorityQueue.backoff_duration(2) == 2.0
+        assert PriorityQueue.backoff_duration(4) == 8.0
+        assert PriorityQueue.backoff_duration(5) == MAX_BACKOFF   # 16 → cap
+        assert PriorityQueue.backoff_duration(9) == MAX_BACKOFF
+
+    def test_unschedulable_flushed_after_interval(self):
+        q = PriorityQueue()
+        q.add(pod("a"))
+        (p, attempts), = q.pop_batch(1, now=0.0)
+        q.add_unschedulable(p, attempts, now=0.0)
+        q.pump(now=UNSCHEDULABLE_FLUSH_INTERVAL - 1)
+        assert q.lengths() == (0, 0, 1)
+        q.pump(now=UNSCHEDULABLE_FLUSH_INTERVAL)
+        assert q.lengths() == (1, 0, 0)
+
+    def test_move_after_pop_sends_failure_to_backoff(self):
+        """moveRequestCycle: event arrives while the pod is mid-cycle → its
+        failure verdict is stale → backoffQ, not unschedulableQ."""
+        q = PriorityQueue()
+        q.add(pod("a"))
+        (p, attempts), = q.pop_batch(1, now=0.0)
+        cycle = q.current_cycle()
+        q.move_all_to_active(now=0.0)          # event during scheduling
+        q.add_unschedulable(p, attempts, now=0.0, cycle=cycle)
+        assert q.lengths() == (0, 1, 0)
+
+    def test_update_moves_unschedulable_to_active(self):
+        q = PriorityQueue()
+        q.add(pod("a"))
+        (p, attempts), = q.pop_batch(1, now=0.0)
+        q.add_unschedulable(p, attempts, now=0.0)
+        q.update(p, now=1.0)
+        assert q.lengths() == (1, 0, 0)
+
+    def test_delete_and_nominated(self):
+        q = PriorityQueue()
+        q.add(pod("a"))
+        q.add_nominated("default/a", "n3")
+        assert q.nominated_node("default/a") == "n3"
+        assert q.nominated_on("n3") == ["default/a"]
+        q.delete("default/a")
+        assert q.nominated_node("default/a") is None
+        assert q.pop_batch(1) == []
+
+    def test_duplicate_add_not_doubled(self):
+        q = PriorityQueue()
+        q.add(pod("a"))
+        q.add(pod("a"))
+        assert q.lengths()[0] == 1
+        assert len(q.pop_batch(10)) == 1

@@ -1,0 +1,45 @@
+"""Regression tests for bugs found by review — each reproduces a case the
+randomized golden seeds missed."""
+
+from kubernetes_tpu.api.types import Node, Pod, Resources
+from kubernetes_tpu.sched.cycle import BatchScheduler
+from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+
+
+class FakeClock:
+    t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_zero_scalar_request_ignores_negative_scalar_free():
+    """PodFitsResources only iterates the pod's *requested* scalar resources
+    (predicates.go:834-841): a pod requesting no GPU must fit on a node whose
+    GPU accounting has gone negative (resource removed while pods still bound),
+    while cpu/mem are checked even at zero request."""
+    node = Node(name="n0", allocatable=Resources.make(cpu=4, memory="8Gi", pods=10))
+    # existing pod consumes a scalar the node no longer advertises → free = -2
+    hog = Pod(name="hog", requests=Resources.make(
+        cpu="100m", memory="64Mi", scalars={"example.com/gpu": 2}))
+    hog.node_name = "n0"
+    pend = Pod(name="plain", requests=Resources.make(cpu="100m", memory="64Mi"))
+    res = BatchScheduler().schedule([node], [hog], [pend])
+    assert res.assignments == ["n0"]
+
+
+def test_spec_update_of_pending_pod_reencodes_snapshot():
+    """A pending pod whose spec shrank via an update event must be scheduled
+    against the new spec, not a stale cached encoding (cache.py snapshot key)."""
+    clock = FakeClock()
+    s = Scheduler(binder=RecordingBinder(), clock=clock)
+    s.on_node_add(Node(name="n0", allocatable=Resources.make(cpu=2, memory="4Gi",
+                                                             pods=10)))
+    big = Pod(name="a", requests=Resources.make(cpu=16, memory="256Mi"))
+    s.on_pod_add(big)
+    assert s.schedule_pending().unschedulable == 1
+    small = Pod(name="a", requests=Resources.make(cpu="100m", memory="256Mi"))
+    s.on_pod_update(big, small)       # same key, new object, new spec
+    clock.t = 5.0                     # past backoff
+    stats = s.schedule_pending()
+    assert stats.scheduled == 1

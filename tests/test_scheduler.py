@@ -1,0 +1,154 @@
+"""End-to-end tests for the stateful watch-driven Scheduler: event handlers →
+queue → batched device cycle → assume/bind lifecycle. The shape of these cases
+follows scheduler_test.go / eventhandlers_test.go in the reference."""
+
+from kubernetes_tpu.api.types import (
+    Node,
+    Pod,
+    Resources,
+    Taint,
+    TaintEffect,
+)
+from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+
+
+def mknode(name, cpu=4, mem="8Gi", **kw):
+    return Node(name=name, allocatable=Resources.make(cpu=cpu, memory=mem, pods=110),
+                **kw)
+
+
+def mkpod(name, cpu="500m", mem="256Mi", **kw):
+    return Pod(name=name, requests=Resources.make(cpu=cpu, memory=mem), **kw)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_happy_path_binds_everything():
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder)
+    for i in range(3):
+        s.on_node_add(mknode(f"n{i}"))
+    for i in range(10):
+        s.on_pod_add(mkpod(f"p{i}", cpu="100m"))
+    stats = s.schedule_pending()
+    assert stats.attempted == 10
+    assert stats.scheduled == 10
+    assert len(binder.bound) == 10
+    # all assumed pods occupy cache state until informer confirms
+    assert s.cache.counts()[1] == 10
+
+
+def test_assume_feedback_across_waves():
+    """Pods scheduled in wave 1 must constrain wave 2 via the cache (assumed
+    pods count as existing)."""
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder)
+    s.on_node_add(mknode("n0", cpu=1))           # fits exactly one 600m pod
+    s.on_pod_add(mkpod("a", cpu="600m"))
+    s1 = s.schedule_pending()
+    assert s1.scheduled == 1
+    s.on_pod_add(mkpod("b", cpu="600m"))
+    s2 = s.schedule_pending()
+    assert s2.scheduled == 0 and s2.unschedulable == 1
+
+
+def test_unschedulable_retries_after_node_add():
+    clock = FakeClock()
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder, clock=clock)
+    s.on_pod_add(mkpod("a"))
+    stats = s.schedule_pending()
+    assert stats.unschedulable == 1              # no nodes at all
+    assert s.queue.lengths() == (0, 0, 1)
+    # node arrives → MoveAllToActiveQueue → retry succeeds after backoff
+    clock.t = 5.0
+    s.on_node_add(mknode("n0"))
+    s.queue.pump(clock.t)
+    stats = s.schedule_pending()
+    assert stats.scheduled == 1
+
+
+def test_bind_failure_rolls_back_assume():
+    binder = RecordingBinder(fail_keys=["default/a"])
+    s = Scheduler(binder=binder)
+    s.on_node_add(mknode("n0"))
+    s.on_pod_add(mkpod("a"))
+    stats = s.schedule_pending()
+    assert stats.bind_errors == 1
+    assert s.cache.get_pod("default/a") is None  # ForgetPod ran
+    assert s.queue.lengths()[2] + s.queue.lengths()[1] == 1  # queued for retry
+
+
+def test_informer_confirmation_and_delete_free_resources():
+    clock = FakeClock()
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder, clock=clock)
+    s.on_node_add(mknode("n0", cpu=1))
+    s.on_pod_add(mkpod("a", cpu="800m"))
+    s.schedule_pending()
+    # informer confirms the binding
+    bound = mkpod("a", cpu="800m")
+    bound.node_name = "n0"
+    s.on_pod_add(bound)
+    assert not s.cache.is_assumed("default/a")
+    # second pod can't fit
+    s.on_pod_add(mkpod("b", cpu="800m"))
+    assert s.schedule_pending().unschedulable == 1
+    # deleting the first frees the node and retries the second (after backoff)
+    s.on_pod_delete(bound)
+    clock.t = 5.0
+    assert s.schedule_pending().scheduled == 1
+
+
+def test_foreign_scheduler_pods_ignored():
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder)
+    s.on_node_add(mknode("n0"))
+    s.on_pod_add(mkpod("mine"))
+    s.on_pod_add(mkpod("theirs", scheduler_name="other-scheduler"))
+    stats = s.schedule_pending()
+    assert stats.attempted == 1
+    assert [k for k, _ in binder.bound] == ["default/mine"]
+
+
+def test_priority_order_within_wave():
+    """Higher-priority pods are scheduled first within a wave, so when
+    capacity runs out it is the low-priority pods that miss."""
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder)
+    s.on_node_add(mknode("n0", cpu=1))
+    s.on_pod_add(mkpod("low", cpu="600m", priority=1, creation_index=0))
+    s.on_pod_add(mkpod("high", cpu="600m", priority=10, creation_index=1))
+    stats = s.schedule_pending()
+    assert stats.assignments.get("default/high") == "n0"
+    assert "default/low" not in stats.assignments
+
+
+def test_tainted_node_rejected_without_toleration():
+    clock = FakeClock()
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder, clock=clock)
+    s.on_node_add(mknode("bad", taints=(Taint("dedicated", "gpu",
+                                              TaintEffect.NO_SCHEDULE),)))
+    s.on_pod_add(mkpod("a"))
+    assert s.schedule_pending().unschedulable == 1
+    clock.t = 5.0
+    s.on_node_add(mknode("good"))
+    assert s.schedule_pending().scheduled == 1
+
+
+def test_run_until_idle_drains_queue():
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder, batch_size=4)
+    for i in range(4):
+        s.on_node_add(mknode(f"n{i}"))
+    for i in range(10):
+        s.on_pod_add(mkpod(f"p{i}", cpu="100m"))
+    total = s.run_until_idle()
+    assert total.scheduled == 10
