@@ -1,0 +1,181 @@
+"""Preemption as a batched device what-if.
+
+Reference semantics (core/generic_scheduler.go):
+  * Preempt (:325) → selectNodesForPreemption (:1032, 16-way parallel) →
+    selectVictimsOnNode (:1125): remove ALL lower-priority pods from the node,
+    check the preemptor fits; then *reprieve* victims one at a time in
+    priority-descending order, keeping each whose restoration still leaves the
+    preemptor feasible; the rest are the node's victims.
+  * pickOneNodeForPreemption (:903): choose the candidate node by (1) fewest
+    PDB violations, (2) minimum highest victim priority, (3) smallest priority
+    sum, (4) fewest victims, (5) latest earliest start time.
+
+TPU re-design — everything is one jitted dispatch:
+  * "remove all potential victims" is a scatter-subtract of victim request rows
+    and term-count contributions over the node axis (no per-node loop);
+  * port what-ifs avoid bitset un-OR-ing (not invertible) by precomputing the
+    pairwise pod-vs-existing-pod conflict vector [E] and scatter-maxing it;
+  * the reprieve loop is a single lax.scan over existing pods in global
+    priority-descending order — each victim only touches its own node's carry
+    row, so per-node sequential semantics are preserved exactly;
+  * node choice is a masked lexicographic argmin on device.
+
+Documented deviations (docs/PARITY.md): no PDB accounting (criterion 1) and no
+start-time tiebreak (criterion 5) — the API surface has neither PDBs nor start
+times yet; reprieve re-checks resources/ports exactly, and handles affinity/
+spread via a conservative precomputed "restoration would re-block" bit instead
+of a full predicate re-run (a victim that *might* re-block is simply not
+reprieved — strictly more victims than the reference in rare affinity cases,
+never a false 'schedulable')."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..state.arrays import Array, ClusterTables, PodArrays
+from .assign import AssignState
+from .fit import _fit
+from .interpod import affinity_rows, domain_of_term, per_node_counts
+from .lattice import CycleArrays
+from .topospread import spread_row
+
+
+class PreemptResult(NamedTuple):
+    node: Array      # scalar i32 — chosen node index, -1 if preemption can't help
+    victims: Array   # [E] bool — victims on the chosen node
+    n_candidates: Array  # scalar i32 — nodes where preemption would work
+
+
+def _pairwise_port_conflict(
+    tables: ClusterTables, cls: Array, cls_e: Array
+) -> Array:
+    """[E] bool: the preemptor's port-set conflicts with existing pod e's."""
+    psets = tables.portsets
+    ps_p = tables.classes.portset[cls]
+    ps_e = tables.classes.portset[jnp.maximum(cls_e, 0)]
+    pp = jnp.maximum(ps_p, 0)
+    pe = jnp.maximum(ps_e, 0)
+    wild_p, pair_p, trip_p = psets.wild_words[pp], psets.pair_words[pp], psets.trip_words[pp]
+    any_e, wild_e, trip_e = psets.pair_words[pe], psets.wild_words[pe], psets.trip_words[pe]
+    # conflict iff a shared (proto,port) pair where either side is wildcard,
+    # or a shared exact (proto,port,ip) triple — port_conflict_row pairwise
+    hits = ((wild_p[None, :] & any_e) | (pair_p[None, :] & wild_e)) != 0
+    trip = (trip_p[None, :] & trip_e) != 0
+    c = hits.any(-1) | trip.any(-1)
+    return c & (ps_p >= 0) & (ps_e >= 0)
+
+
+def preempt_for_pod(
+    tables: ClusterTables,
+    cyc: CycleArrays,
+    existing: PodArrays,
+    cls: Array,            # scalar: preemptor's class id
+    node_name_req: Array,  # scalar: spec.nodeName id or -1
+    priority: Array,       # scalar: preemptor's priority
+    D: int,
+) -> PreemptResult:
+    nodes, classes, terms = tables.nodes, tables.classes, tables.terms
+    N = nodes.valid.shape[0]
+    E = existing.valid.shape[0]
+    I32MAX = jnp.iinfo(jnp.int32).max
+
+    cls_e = jnp.maximum(existing.cls, 0)
+    node_e = existing.node_id
+    on_node = existing.valid & (node_e >= 0)
+    vict_pot = on_node & (existing.priority < priority)        # [E]
+    node_e_safe = jnp.where(on_node, node_e, N)
+
+    # ---- what-if: all potential victims removed (selectVictimsOnNode pass 1)
+    req_e = tables.reqs.vec[classes.rid[cls_e]]                # [E, R]
+    vict_req = jnp.where(vict_pot[:, None], req_e, 0)
+    used_wo = nodes.used.at[jnp.minimum(node_e_safe, N - 1)].add(
+        -jnp.where((node_e_safe < N)[:, None], vict_req, 0)
+    )
+
+    survivors = PodArrays(
+        valid=existing.valid & ~vict_pot,
+        name_id=existing.name_id, ns=existing.ns, cls=existing.cls,
+        priority=existing.priority, creation=existing.creation,
+        node_id=existing.node_id, node_name_req=existing.node_name_req,
+    )
+    CNT_wo = per_node_counts(cyc.TM, survivors, N)             # [S, N]
+    HOLD_wo = per_node_counts(cyc.has_anti.T, survivors, N)
+
+    # ports: conflict[n] = any surviving pod on n whose ports clash with ours
+    c_e = _pairwise_port_conflict(tables, cls, cls_e)          # [E]
+    live_clash = (c_e & on_node & ~vict_pot).astype(jnp.int32)
+    conflict_wo = jnp.zeros((N + 1,), jnp.int32).at[node_e_safe].max(live_clash)[:N] > 0
+
+    # feasibility with all victims gone
+    req_p = tables.reqs.vec[classes.rid[cls]]
+    fit = _fit(req_p[None, :], nodes.alloc - used_wo) & nodes.valid
+    aff_ok, anti_ok = affinity_rows(cls, classes, terms, cyc.TM, CNT_wo, HOLD_wo, nodes, D)
+    spread_ok = spread_row(cls, classes, terms, cyc.TM, CNT_wo, cyc.ELD,
+                           cyc.static.node_match[cls], nodes, D)
+    host_ok = (node_name_req < 0) | (nodes.name_id == node_name_req)
+    cand = (cyc.static.mask[cls] & fit & ~conflict_wo & aff_ok & anti_ok
+            & spread_ok & host_ok)                              # [N]
+
+    # ---- precompute "restoring pod e would re-block the preemptor" [E] ----
+    # own anti-affinity: an anti term of ours matches e's class and e's node
+    # carries the term's key
+    ans = classes.anti_terms[cls]                               # [AN]
+    sa = jnp.maximum(ans, 0)
+    _, hk_anti = domain_of_term(nodes, terms.topo_key[sa])      # [AN, N]
+    m_own = (ans >= 0)[:, None] & cyc.TM[sa]                    # [AN, SC]
+    own_block = (m_own[:, cls_e] &
+                 hk_anti[:, jnp.minimum(node_e_safe, N - 1)]).any(0)   # [E]
+    # symmetry: e holds an anti term that matches us, key present on e's node
+    _, hk_s = domain_of_term(nodes, terms.topo_key)             # [S, N]
+    sym_terms = cyc.has_anti[cls_e] & cyc.TM[:, cls][None, :]   # [E, S]
+    sym_block = (sym_terms & hk_s[:, jnp.minimum(node_e_safe, N - 1)].T).any(1)
+    # hard topology-spread: restoring a matching pod bumps the domain count —
+    # conservatively never reprieve such victims
+    ts_ids = classes.tsc_term[cls]
+    ts = jnp.maximum(ts_ids, 0)
+    hard_ts = (ts_ids >= 0) & classes.tsc_hard[cls]
+    spread_block = (hard_ts[:, None] & cyc.TM[ts][:, cls_e]).any(0)     # [E]
+    reblock = own_block | sym_block | spread_block
+
+    # ---- reprieve scan (selectVictimsOnNode pass 2), priority-desc order ----
+    order = jnp.lexsort((jnp.arange(E), -existing.priority, ~vict_pot))
+
+    def step(carry, e):
+        used, conflict, victim = carry
+        n = jnp.minimum(node_e_safe[e], N - 1)
+        is_v = vict_pot[e] & cand[n]
+        new_used_n = used[n] + req_e[e]
+        fit_n = _fit(req_p, nodes.alloc[n] - new_used_n)
+        new_conf = conflict[n] | c_e[e]
+        keep = is_v & fit_n & ~new_conf & ~reblock[e]
+        used = used.at[n].set(jnp.where(keep, new_used_n, used[n]))
+        conflict = conflict.at[n].set(jnp.where(keep, new_conf, conflict[n]))
+        victim = victim.at[e].set(is_v & ~keep)
+        return (used, conflict, victim), None
+
+    init = (used_wo, conflict_wo, jnp.zeros((E,), bool))
+    (used_f, conf_f, victim), _ = jax.lax.scan(step, init, order)
+
+    # ---- pickOneNodeForPreemption (:903) ----
+    vprio = jnp.where(victim, existing.priority, 0)
+    vmask = victim & (node_e_safe < N)
+    idx = jnp.where(vmask, node_e_safe, N)
+    num_v = jnp.zeros((N + 1,), jnp.int32).at[idx].add(vmask.astype(jnp.int32))[:N]
+    sum_p = jnp.zeros((N + 1,), jnp.int32).at[idx].add(jnp.where(vmask, existing.priority, 0))[:N]
+    max_p = jnp.full((N + 1,), -I32MAX, jnp.int32).at[idx].max(
+        jnp.where(vmask, existing.priority, -I32MAX))[:N]
+
+    big = I32MAX
+    key1 = jnp.where(cand, jnp.where(num_v > 0, max_p, -I32MAX), big)
+    key2 = jnp.where(cand, sum_p, big)
+    key3 = jnp.where(cand, num_v, big)
+    choice_order = jnp.lexsort((jnp.arange(N), key3, key2, key1))
+    best = choice_order[0]
+    any_cand = cand.any()
+    node = jnp.where(any_cand, best, -1)
+    victims = victim & (node_e == node) & any_cand
+    return PreemptResult(node=node.astype(jnp.int32), victims=victims,
+                         n_candidates=cand.sum().astype(jnp.int32))

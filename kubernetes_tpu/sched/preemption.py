@@ -1,0 +1,118 @@
+"""Host-side preemption driver: wires the device what-if (ops/preempt.py) into
+the scheduling wave.
+
+Flow mirrors scheduler.go:453-523 + core Preempt (generic_scheduler.go:325):
+a pod that failed Filter everywhere triggers one preemption dispatch; if a
+candidate node exists, the victims are evicted (async API deletes in the
+reference — here a pluggable evictor), the preemptor is *nominated* onto the
+node (queue bookkeeping, scheduling_queue.go:136-138) and requeued; the actual
+placement happens in a later wave once the victims' resources are released.
+
+PodEligibleToPreemptOthers (generic_scheduler.go:1085): a pod that already has
+a nominated node is assumed to be waiting for its victims to exit and does not
+preempt again."""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..api.types import Pod
+from ..ops.preempt import PreemptResult, preempt_for_pod
+from ..state.cache import Snapshot
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def _preempt(tables, cyc_existing, cls, nnr, prio, D, keys):
+    from ..ops.lattice import build_cycle
+
+    uk, ev = keys
+    existing = cyc_existing
+    cyc = build_cycle(tables, existing, uk, ev, D)
+    return preempt_for_pod(tables, cyc, existing, cls, nnr, prio, D)
+
+
+class CacheEvictor:
+    """Default evictor: delete the victim from the scheduler's world (the
+    reference issues pod DELETE API calls, generic_scheduler.go:352-364; with
+    an apiserver attached use an API-backed evictor instead)."""
+
+    def __init__(self) -> None:
+        self.evicted: List[str] = []
+
+    def evict(self, scheduler, victim_key: str) -> bool:
+        pod = scheduler.cache.get_pod(victim_key)
+        if pod is None:
+            return False
+        scheduler.cache.remove_pod(victim_key)
+        self.evicted.append(victim_key)
+        return True
+
+
+class Preemptor:
+    def __init__(self, evictor: Optional[CacheEvictor] = None) -> None:
+        self.evictor = evictor or CacheEvictor()
+        self.attempts = 0
+        self.successes = 0
+
+    def try_preempt(self, scheduler, pod: Pod, attempts: int,
+                    snap: Snapshot, now: float) -> bool:
+        """Returns True iff preemption was performed (victims evicted and the
+        pod nominated + requeued). False → caller handles the failure as a
+        plain unschedulable pod."""
+        if pod.priority <= 0:
+            return False  # only priority pods preempt (disablePreemption for
+                          # the rest is the config default behavior)
+        if scheduler.queue.nominated_node(pod.key) is not None:
+            # it failed even on its nominated node (someone stole the freed
+            # space) — clear the nomination so the next failure can preempt
+            # again (the reference clears Status.NominatedNodeName here)
+            scheduler.queue.delete_nominated(pod.key)
+            return False
+        self.attempts += 1
+
+        # find this pod's row in the snapshot's pending arrays
+        try:
+            row = [k for k, _ in snap.pending_keys].index(pod.key)
+        except ValueError:
+            return False
+
+        enc = scheduler.encoder
+        from .cycle import UNSCHEDULABLE_TAINT_KEY
+
+        uk = jnp.int32(enc.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+        ev = jnp.int32(enc.vocabs.label_vals.get(""))
+        res: PreemptResult = _preempt(
+            snap.tables, snap.existing,
+            snap.pending.cls[row], snap.pending.node_name_req[row],
+            jnp.int32(pod.priority), snap.dims.D, (uk, ev),
+        )
+        node_idx = int(jax.device_get(res.node))
+        if node_idx < 0:
+            return False
+
+        victims_mask = jax.device_get(res.victims)
+        victim_keys = [
+            snap.existing_keys[i]
+            for i in range(min(len(snap.existing_keys), victims_mask.shape[0]))
+            if victims_mask[i]
+        ]
+        if not victim_keys:
+            # a candidate with zero victims means the pod should simply fit —
+            # evicting nothing and nominating would only mask a filter
+            # discrepancy; let the normal retry path handle it
+            return False
+        for vk in victim_keys:
+            self.evictor.evict(scheduler, vk)
+
+        node_name = snap.node_order[node_idx]
+        scheduler.queue.add_nominated(pod.key, node_name)
+        # cache changed → move event; requeue the preemptor for a prompt retry
+        # (real attempt count preserved so exponential backoff keeps growing)
+        scheduler.queue.move_all_to_active(now)
+        scheduler.queue.add_unschedulable(pod, attempts=attempts, now=now)
+        self.successes += 1
+        return True

@@ -159,24 +159,15 @@ class PriorityQueue:
                 out.append((e.pod, e.attempts))
         return out
 
-    def pop_blocking(self, timeout: Optional[float] = None) -> Optional[Tuple[Pod, int]]:
-        """Pop one pod, blocking like the reference's Pop (scheduling_queue.go
-        Pop blocks on a condition variable until activeQ is non-empty)."""
+    def wait_nonempty(self, timeout: Optional[float] = None) -> bool:
+        """Block until activeQ is non-empty (the reference's Pop blocks on a
+        condition variable, scheduling_queue.go Pop); the wave driver then
+        drains with pop_batch."""
         with self._mu:
             while not self._active:
                 if not self._cond.wait(timeout):
-                    return None
-            self._cycle += 1
-            batch = None
-            while self._active:
-                _, _, _, e = heapq.heappop(self._active)
-                if self._active_keys.get(e.pod.key) is not e:
-                    continue
-                del self._active_keys[e.pod.key]
-                e.attempts += 1
-                batch = (e.pod, e.attempts)
-                break
-            return batch
+                    return False
+            return True
 
     def move_all_to_active(self, now: float = 0.0) -> int:
         """MoveAllToActiveQueue (scheduling_queue.go:358): a cluster event

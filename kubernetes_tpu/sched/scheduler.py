@@ -117,6 +117,9 @@ class Scheduler:
                 self.cache.update_pod(new)
             else:
                 self.cache.add_pod(new)
+            # label changes on bound pods can unblock affinity waiters
+            # (eventhandlers.go moves pods on assigned-pod updates)
+            self.queue.move_all_to_active(self.clock())
         elif self.responsible_for(new):
             self.queue.update(new, now=self.clock())
 
@@ -172,15 +175,16 @@ class Scheduler:
                               snap.existing)
         node_idx = jax.device_get(res.node)
 
+        failures: List[Tuple[Pod, int]] = []
         for i, (pod, attempts) in enumerate(batch):
             ni = int(node_idx[i])
             if ni < 0:
-                handled = False
-                if self.preemptor is not None:
-                    handled = self.preemptor.try_preempt(self, pod, snap, now)
-                if not handled:
-                    stats.unschedulable += 1
-                    self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
+                failures.append((pod, attempts))
+                continue
+            if self.cache.get_pod(pod.key) is not None:
+                # skipPodSchedule: a stale queue entry for a pod that is
+                # already assumed/bound (e.g. an update raced the informer
+                # confirmation) — do not double-assume
                 continue
             node_name = snap.node_order[ni]
             self.cache.assume_pod(pod, node_name)
@@ -198,6 +202,21 @@ class Scheduler:
                 # rollback + retry (scheduler.go:717,732 → ForgetPod)
                 self.cache.forget_pod(pod.key)
                 stats.bind_errors += 1
+                self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
+
+        # ---- preemption pass: AFTER commits, against a FRESH snapshot so the
+        # what-if sees pods assumed earlier in this very wave (otherwise a
+        # preemptor could evict victims for space the wave already consumed)
+        for pod, attempts in failures:
+            handled = False
+            if self.preemptor is not None:
+                fresh = self.cache.snapshot(
+                    self.encoder, [p for p, _ in failures], self.base_dims,
+                    extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
+                )
+                handled = self.preemptor.try_preempt(self, pod, attempts, fresh, now)
+            if not handled:
+                stats.unschedulable += 1
                 self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
 
         stats.cycle_seconds = time.perf_counter() - t0

@@ -74,6 +74,8 @@ class Snapshot:
     pending: object           # PodArrays (device)
     dims: Dims
     pending_keys: Tuple[Tuple[str, int], ...]  # (pod key, object identity)
+    existing_keys: Tuple[str, ...] = ()  # row order of `existing` (preemption
+                                         # maps victim rows back to pod keys)
 
 
 class SchedulerCache:
@@ -273,6 +275,7 @@ class SchedulerCache:
             pending=jax.device_put(pe),
             dims=d,
             pending_keys=pending_keys,
+            existing_keys=tuple(p.key for p in existing),
         )
         with self._mu:
             self._snapshot = snap

@@ -1,0 +1,148 @@
+"""Preemption tests following the shapes of core/generic_scheduler_test.go
+(TestSelectNodesForPreemption / TestPickOneNodeForPreemption) and
+test/integration/scheduler/preemption_test.go."""
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    Resources,
+)
+from kubernetes_tpu.sched.preemption import Preemptor
+from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+
+HOSTNAME = "kubernetes.io/hostname"
+
+
+class FakeClock:
+    t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def mknode(name, cpu=2, mem="4Gi"):
+    return Node(name=name, labels={HOSTNAME: name},
+                allocatable=Resources.make(cpu=cpu, memory=mem, pods=110))
+
+
+def bound(name, node, cpu="500m", mem="256Mi", priority=0, **kw):
+    p = Pod(name=name, requests=Resources.make(cpu=cpu, memory=mem),
+            priority=priority, **kw)
+    p.node_name = node
+    return p
+
+
+def mksched(clock=None):
+    clock = clock or FakeClock()
+    s = Scheduler(binder=RecordingBinder(), clock=clock, preemptor=Preemptor())
+    return s, clock
+
+
+def test_preempts_lower_priority_and_schedules_after_eviction():
+    s, clock = mksched()
+    s.on_node_add(mknode("n0", cpu=1))
+    s.on_pod_add(bound("victim", "n0", cpu="800m", priority=0))
+    s.on_pod_add(Pod(name="vip", priority=100,
+                     requests=Resources.make(cpu="800m", memory="256Mi")))
+    st = s.schedule_pending()
+    assert st.scheduled == 0
+    # preemption ran: victim evicted, vip nominated on n0, requeued
+    assert s.preemptor.evictor.evicted == ["default/victim"]
+    assert s.queue.nominated_node("default/vip") == "n0"
+    assert s.cache.get_pod("default/victim") is None
+    clock.t = 5.0
+    st2 = s.schedule_pending()
+    assert st2.assignments.get("default/vip") == "n0"
+    # nomination cleared once bound
+    assert s.queue.nominated_node("default/vip") is None
+
+
+def test_no_preemption_of_equal_or_higher_priority():
+    s, clock = mksched()
+    s.on_node_add(mknode("n0", cpu=1))
+    s.on_pod_add(bound("peer", "n0", cpu="800m", priority=100))
+    s.on_pod_add(Pod(name="vip", priority=100,
+                     requests=Resources.make(cpu="800m", memory="256Mi")))
+    st = s.schedule_pending()
+    assert st.unschedulable == 1
+    assert s.preemptor.evictor.evicted == []
+    assert s.cache.get_pod("default/peer") is not None
+
+
+def test_zero_priority_pod_never_preempts():
+    s, clock = mksched()
+    s.on_node_add(mknode("n0", cpu=1))
+    s.on_pod_add(bound("victim", "n0", cpu="800m", priority=-5))
+    s.on_pod_add(Pod(name="plain", priority=0,
+                     requests=Resources.make(cpu="800m", memory="256Mi")))
+    st = s.schedule_pending()
+    assert st.unschedulable == 1
+    assert s.preemptor.evictor.evicted == []
+
+
+def test_minimal_victim_set_reprieve():
+    """Node has three low-priority pods but evicting ONE 600m pod suffices for
+    the 500m preemptor: reprieve must restore the others (selectVictimsOnNode
+    pass 2)."""
+    s, clock = mksched()
+    s.on_node_add(mknode("n0", cpu=2))
+    s.on_pod_add(bound("a", "n0", cpu="600m", priority=1))
+    s.on_pod_add(bound("b", "n0", cpu="600m", priority=2))
+    s.on_pod_add(bound("c", "n0", cpu="600m", priority=3))
+    s.on_pod_add(Pod(name="vip", priority=100,
+                     requests=Resources.make(cpu="500m", memory="128Mi")))
+    s.schedule_pending()
+    # greedy reprieve in priority-desc order keeps c and b (2*600+500 ≤ 2000),
+    # evicts only the lowest-priority a
+    assert s.preemptor.evictor.evicted == ["default/a"]
+
+
+def test_picks_node_with_lowest_max_victim_priority():
+    """pickOneNodeForPreemption criterion 2: prefer the node whose highest
+    victim priority is smallest."""
+    s, clock = mksched()
+    s.on_node_add(mknode("n0", cpu=1))
+    s.on_node_add(mknode("n1", cpu=1))
+    s.on_pod_add(bound("hi", "n0", cpu="900m", priority=50))
+    s.on_pod_add(bound("lo", "n1", cpu="900m", priority=5))
+    s.on_pod_add(Pod(name="vip", priority=100,
+                     requests=Resources.make(cpu="500m", memory="128Mi")))
+    s.schedule_pending()
+    assert s.preemptor.evictor.evicted == ["default/lo"]
+    assert s.queue.nominated_node("default/vip") == "n1"
+
+
+def test_preemption_helps_anti_affinity_block():
+    """Victim's anti-affinity blocks the preemptor; eviction clears it — and
+    the reprieve pass must NOT restore the blocking victim."""
+    sel = LabelSelector.of(match_labels={"app": "red"})
+    s, clock = mksched()
+    s.on_node_add(mknode("n0"))
+    blocker = bound("blocker", "n0", cpu="100m", priority=1)
+    blocker.labels = {"app": "blue"}
+    blocker.affinity = Affinity(anti_required=(
+        PodAffinityTerm(selector=sel, topology_key=HOSTNAME),))
+    s.on_pod_add(blocker)
+    vip = Pod(name="vip", priority=100, labels={"app": "red"},
+              requests=Resources.make(cpu="100m", memory="64Mi"))
+    s.on_pod_add(vip)
+    st = s.schedule_pending()
+    assert st.scheduled == 0
+    assert s.preemptor.evictor.evicted == ["default/blocker"]
+    clock.t = 5.0
+    st2 = s.schedule_pending()
+    assert st2.assignments.get("default/vip") == "n0"
+
+
+def test_no_candidate_when_pod_cannot_fit_even_empty():
+    s, clock = mksched()
+    s.on_node_add(mknode("n0", cpu=1))
+    s.on_pod_add(bound("v", "n0", cpu="500m", priority=0))
+    s.on_pod_add(Pod(name="huge", priority=100,
+                     requests=Resources.make(cpu=8, memory="256Mi")))
+    st = s.schedule_pending()
+    assert st.unschedulable == 1
+    assert s.preemptor.evictor.evicted == []

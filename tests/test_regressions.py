@@ -43,3 +43,46 @@ def test_spec_update_of_pending_pod_reencodes_snapshot():
     clock.t = 5.0                     # past backoff
     stats = s.schedule_pending()
     assert stats.scheduled == 1
+
+
+def test_stale_queue_entry_for_assumed_pod_skipped():
+    """A queue update racing the informer confirmation must not abort the wave
+    via a double-assume (skipPodSchedule analog)."""
+    clock = FakeClock()
+    s = Scheduler(binder=RecordingBinder(), clock=clock)
+    s.on_node_add(Node(name="n0", allocatable=Resources.make(cpu=4, memory="8Gi",
+                                                             pods=10)))
+    a = Pod(name="a", requests=Resources.make(cpu="100m", memory="64Mi"))
+    s.on_pod_add(a)
+    assert s.schedule_pending().scheduled == 1       # a is now assumed
+    # an update event with the pod still looking unassigned requeues it
+    a2 = Pod(name="a", requests=Resources.make(cpu="200m", memory="64Mi"))
+    b = Pod(name="b", requests=Resources.make(cpu="100m", memory="64Mi"))
+    s.queue.update(a2, now=0.0)
+    s.on_pod_add(b)
+    stats = s.schedule_pending()                     # must not raise; b lands
+    assert stats.assignments.get("default/b") == "n0"
+    assert s.cache.get_pod("default/a").requests.milli_cpu == 100  # untouched
+
+
+def test_preemption_sees_same_wave_assumptions():
+    """A preemptor failing in a wave must run its what-if against a snapshot
+    that includes pods assumed earlier in the SAME wave — no phantom
+    candidates, no useless evictions."""
+    from kubernetes_tpu.sched.preemption import Preemptor
+
+    clock = FakeClock()
+    s = Scheduler(binder=RecordingBinder(), clock=clock, preemptor=Preemptor())
+    s.on_node_add(Node(name="n0", allocatable=Resources.make(cpu=1, memory="4Gi",
+                                                             pods=10)))
+    # two equal-priority pods pop in one wave; only one fits
+    s.on_pod_add(Pod(name="a", priority=100, creation_index=0,
+                     requests=Resources.make(cpu="700m", memory="64Mi")))
+    s.on_pod_add(Pod(name="b", priority=100, creation_index=1,
+                     requests=Resources.make(cpu="700m", memory="64Mi")))
+    stats = s.schedule_pending()
+    assert stats.scheduled == 1
+    # b must NOT have preempted anything (a is same priority) nor been
+    # nominated onto space a already took
+    assert s.preemptor.evictor.evicted == []
+    assert s.queue.nominated_node("default/b") is None
