@@ -1,0 +1,27 @@
+"""Scheduler-extender boundary: the TPU lattice as an out-of-process extender
+(server) and extender webhooks callable from our own scheduler (client).
+Reference: pkg/scheduler/core/extender.go + apis/extender/v1/types.go."""
+
+from .backend import ExtenderBackend
+from .client import ExtenderConfig, ExtenderError, HTTPExtender
+from .server import ExtenderServer
+from .wire import (
+    ExtenderArgs,
+    ExtenderBindingArgs,
+    ExtenderBindingResult,
+    ExtenderFilterResult,
+    ExtenderPreemptionArgs,
+    ExtenderPreemptionResult,
+    HostPriority,
+    MAX_EXTENDER_PRIORITY,
+    MetaVictims,
+    Victims,
+)
+
+__all__ = [
+    "ExtenderBackend", "ExtenderConfig", "ExtenderError", "HTTPExtender",
+    "ExtenderServer", "ExtenderArgs", "ExtenderBindingArgs",
+    "ExtenderBindingResult", "ExtenderFilterResult", "ExtenderPreemptionArgs",
+    "ExtenderPreemptionResult", "HostPriority", "MAX_EXTENDER_PRIORITY",
+    "MetaVictims", "Victims",
+]

@@ -168,6 +168,82 @@ def feasible_matrix(
     )(pods.cls, pods.node_name_req, pods.valid)
 
 
+class MaskComponents(NamedTuple):
+    """Per-predicate [P, N] masks for failure diagnosis — the tensor analog of
+    PredicateFailureReason lists (predicates.go error types). Component names
+    follow the reference predicate names (algorithm/predicates/error.go)."""
+
+    node_match: Array   # MatchNodeSelector / node affinity
+    taints: Array       # PodToleratesNodeTaints (incl. CheckNodeUnschedulable)
+    fit: Array          # PodFitsResources
+    ports: Array        # PodFitsHostPorts
+    affinity: Array     # MatchInterPodAffinity (required affinity half)
+    anti: Array         # MatchInterPodAffinity (anti-affinity half)
+    spread: Array       # EvenPodsSpread
+    host: Array         # PodFitsHost (spec.nodeName)
+
+
+def mask_components(
+    tables: ClusterTables, cyc: CycleArrays, pods: PodArrays
+) -> MaskComponents:
+    """Decomposed feasibility against the initial state, vmapped over pods."""
+    state = initial_state(tables, cyc)
+    nodes, classes, terms = tables.nodes, tables.classes, tables.terms
+    D = cyc.ELD.shape[2] - 1
+
+    def row(c, nnr, v):
+        req_vec = tables.reqs.vec[classes.rid[c]]
+        fit = fit_row(req_vec, state.used, nodes.alloc, nodes.valid)
+        ps = classes.portset[c]
+        psafe = jnp.maximum(ps, 0)
+        conflict = port_conflict_row(
+            tables.portsets.wild_words[psafe],
+            tables.portsets.pair_words[psafe],
+            tables.portsets.trip_words[psafe],
+            state.ppa, state.ppw, state.ppt,
+        )
+        port_ok = (ps < 0) | ~conflict
+        aff_ok, anti_ok = affinity_rows(
+            c, classes, terms, cyc.TM, state.CNT, state.HOLD, nodes, D
+        )
+        spread_ok = spread_row(
+            c, classes, terms, cyc.TM, state.CNT, cyc.ELD,
+            cyc.static.node_match[c], nodes, D,
+        )
+        host_ok = (nnr < 0) | (nodes.name_id == nnr)
+        nm = cyc.static.node_match[c]
+        # static.mask = node_match ∧ taint_ok ∧ unsched_pass ∧ class valid;
+        # recover the taint/unschedulable part by division
+        taints_ok = cyc.static.mask[c] | ~nm
+        return nm & v, taints_ok, fit, port_ok, aff_ok, anti_ok, spread_ok, host_ok
+
+    parts = jax.vmap(row)(pods.cls, pods.node_name_req, pods.valid)
+    return MaskComponents(*parts)
+
+
+def score_matrix(
+    tables: ClusterTables, cyc: CycleArrays, pods: PodArrays
+) -> Array:
+    """[P, N] Score for every pending pod against the *initial* state — the
+    tensor analog of prioritizeNodes (generic_scheduler.go:714-869): static
+    lattice scores (preferred node affinity, taint PreferNoSchedule) plus
+    least-requested/balanced-allocation plus soft inter-pod affinity, all
+    weight-1 summed. Infeasible nodes score -inf."""
+    state = initial_state(tables, cyc)
+    nodes, classes, terms = tables.nodes, tables.classes, tables.terms
+    D = cyc.ELD.shape[2] - 1
+
+    def row(c, nnr, v):
+        req_vec = tables.reqs.vec[classes.rid[c]]
+        mask = pod_mask_row(tables, cyc, state, c, nnr, v)
+        least, balanced = resource_scores_row(req_vec, state.used, nodes.alloc)
+        soft_ip = soft_affinity_row(c, classes, terms, state.CNT, nodes, D)
+        score = cyc.static.score[c] + least + balanced + soft_ip
+        return jnp.where(mask, score, -jnp.inf)
+
+    return jax.vmap(row)(pods.cls, pods.node_name_req, pods.valid)
+
+
 def initial_state(tables: ClusterTables, cyc: CycleArrays) -> AssignState:
     n = tables.nodes
     return AssignState(

@@ -59,6 +59,40 @@ def _feasible(
     return feasible_matrix(tables, cyc, pending)
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
+def _scores(
+    tables: ClusterTables,
+    pending: PodArrays,
+    keys: Tuple[jnp.ndarray, jnp.ndarray],
+    D: int,
+    existing: PodArrays,
+) -> jnp.ndarray:
+    """[P, N] Score matrix — prioritizeNodes as one dispatch (extender
+    Prioritize verb, golden tests)."""
+    from ..ops.assign import score_matrix
+
+    uk, ev = keys
+    cyc = build_cycle(tables, existing, uk, ev, D)
+    return score_matrix(tables, cyc, pending)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _diagnose(
+    tables: ClusterTables,
+    pending: PodArrays,
+    keys: Tuple[jnp.ndarray, jnp.ndarray],
+    D: int,
+    existing: PodArrays,
+):
+    """Per-predicate [P, N] component masks (PredicateFailureReason analog) —
+    module-level jit so repeated extender Filter calls hit the compile cache."""
+    from ..ops.assign import mask_components
+
+    uk, ev = keys
+    cyc = build_cycle(tables, existing, uk, ev, D)
+    return mask_components(tables, cyc, pending)
+
+
 @dataclass
 class CycleResult:
     """Placements for one cycle. `assignments[i]` is the node name for

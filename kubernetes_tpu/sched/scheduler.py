@@ -83,6 +83,7 @@ class Scheduler:
         base_dims: Optional[Dims] = None,
         clock: Callable[[], float] = time.monotonic,
         preemptor: Optional["object"] = None,
+        extenders: Sequence["object"] = (),
     ) -> None:
         self.binder = binder
         self.cache = cache or SchedulerCache()
@@ -93,6 +94,11 @@ class Scheduler:
         self.clock = clock
         self.encoder = Encoder()
         self.preemptor = preemptor  # set by sched.preemption.attach()
+        # HTTPExtender list (generic_scheduler.go:547-574,834-869). When any
+        # extender is configured, pods it is interested in take the per-pod
+        # path (`_schedule_one_with_extenders`) — the extender protocol is
+        # per-pod HTTP anyway, so the reference's own round-trip cost applies.
+        self.extenders = list(extenders)
 
     # ------------------------------------------------------------------ #
     # event handlers (eventhandlers.go)
@@ -160,7 +166,22 @@ class Scheduler:
         batch = self.queue.pop_batch(self.batch_size, now=now)
         cycle = self.queue.current_cycle()
         stats = CycleStats(attempted=len(batch))
+
+        # pods an extender is interested in take the per-pod extender path
+        # after the batched wave (they must see the wave's assumes)
+        ext_batch: List[Tuple[Pod, int]] = []
+        if self.extenders:
+            ext_keys = {p.key for p, _ in batch
+                        if any(e.is_interested(p) for e in self.extenders)}
+            ext_batch = [(p, a) for p, a in batch if p.key in ext_keys]
+            batch = [(p, a) for p, a in batch if p.key not in ext_keys]
+
+        if not batch and not ext_batch:
+            return stats
         if not batch:
+            for pod, attempts in ext_batch:
+                self._schedule_one_with_extenders(pod, attempts, now, cycle, stats)
+            stats.cycle_seconds = time.perf_counter() - t0
             return stats
 
         pending = [p for p, _ in batch]
@@ -219,8 +240,101 @@ class Scheduler:
                 stats.unschedulable += 1
                 self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
 
+        for pod, attempts in ext_batch:
+            self._schedule_one_with_extenders(pod, attempts, now, cycle, stats)
+
         stats.cycle_seconds = time.perf_counter() - t0
         return stats
+
+    def _schedule_one_with_extenders(
+        self, pod: Pod, attempts: int, now: float, cycle: int, stats: CycleStats
+    ) -> None:
+        """Per-pod path with extender round-trips: lattice mask+score → extender
+        Filter per extender (generic_scheduler.go:547-574) → extender Prioritize
+        rescaled ×weight×(MaxNodeScore/MaxExtenderPriority) (:834-869) →
+        selectHost → assume → bind (extender Bind if one offers it, :397)."""
+        from ..extender.client import ExtenderError
+        from .cycle import _scores
+
+        if self.cache.get_pod(pod.key) is not None:
+            return  # stale queue entry (skipPodSchedule)
+
+        snap = self.cache.snapshot(
+            self.encoder, [pod], self.base_dims,
+            extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
+        )
+        self.encoder.vocabs.label_vals.intern("")
+        uk = jnp.int32(self.encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+        ev = jnp.int32(self.encoder.vocabs.label_vals.get(""))
+        # one dispatch: infeasible nodes are -inf in the score matrix
+        raw = jax.device_get(_scores(
+            snap.tables, snap.pending, (uk, ev), snap.dims.D, snap.existing))[0]
+
+        nodes_by_name = {n.name: n for n in self.cache.nodes()}
+        feasible: List[str] = []
+        combined: Dict[str, float] = {}
+        for i, name in enumerate(snap.node_order):
+            if raw[i] != float("-inf"):
+                feasible.append(name)
+                combined[name] = float(raw[i])
+
+        failed = False
+        for ext in self.extenders:
+            if not ext.is_interested(pod):
+                continue
+            try:
+                names, _ = ext.filter(pod, [nodes_by_name[n] for n in feasible])
+                allowed = set(names)
+                feasible = [n for n in feasible if n in allowed]
+                escore, weight = ext.prioritize(
+                    pod, [nodes_by_name[n] for n in feasible])
+                for n in feasible:
+                    # extender scores 0-10 rescale to the 0-100 plugin range
+                    combined[n] = combined.get(n, 0.0) + escore.get(n, 0) * weight * 10.0
+            except ExtenderError:
+                if getattr(ext.config, "ignorable", False):
+                    continue  # extender.go:153-157 Ignorable
+                failed = True
+                break
+            if not feasible:
+                break
+
+        if failed or not feasible:
+            # FitError → preemption, same as the batched path (scheduler.go:629)
+            handled = False
+            if not failed and self.preemptor is not None:
+                fresh = self.cache.snapshot(
+                    self.encoder, [pod], self.base_dims,
+                    extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
+                )
+                handled = self.preemptor.try_preempt(self, pod, attempts, fresh, now)
+            if not handled:
+                stats.unschedulable += 1
+                self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
+            return
+
+        best = max(feasible, key=lambda n: combined.get(n, float("-inf")))
+        self.cache.assume_pod(pod, best)
+        self.queue.delete_nominated(pod.key)
+        binder_ext = next(
+            (e for e in self.extenders if e.is_binder and e.is_interested(pod)), None)
+        ok = False
+        try:
+            if binder_ext is not None:
+                binder_ext.bind(pod, best)
+                ok = True
+            else:
+                ok = self.binder.bind(pod, best)
+        except Exception:
+            ok = False
+        if ok:
+            self.cache.finish_binding(pod.key, now)
+            stats.scheduled += 1
+            stats.assignments[pod.key] = best
+        else:
+            self.cache.forget_pod(pod.key)
+            stats.bind_errors += 1
+            self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
 
     def run_until_idle(self, max_waves: int = 100) -> CycleStats:
         """Drive waves until the active queue drains (integration-test helper;

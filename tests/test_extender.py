@@ -1,0 +1,283 @@
+"""Extender boundary tests, modeled on the reference's ladder: in-process
+backend calls first (FakeExtender style, core/extender_test.go:122-143), then
+real HTTP servers on ephemeral ports (integration extender_test.go:290-312
+httptest.NewServer analog), exercised through the HTTPExtender client."""
+
+import json
+import urllib.request
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    Requirement,
+    Resources,
+    Op,
+    Taint,
+    TaintEffect,
+    Toleration,
+    TolerationOp,
+)
+from kubernetes_tpu.api.v1 import node_from_v1, node_to_v1, pod_from_v1, pod_to_v1
+from kubernetes_tpu.extender import (
+    ExtenderArgs,
+    ExtenderBackend,
+    ExtenderBindingArgs,
+    ExtenderConfig,
+    ExtenderServer,
+    HTTPExtender,
+)
+
+
+def mknode(name, cpu=4, mem="8Gi", labels=None, **kw):
+    return Node(name=name, labels=labels or {},
+                allocatable=Resources.make(cpu=cpu, memory=mem, pods=110), **kw)
+
+
+def mkpod(name, cpu="500m", mem="256Mi", **kw):
+    return Pod(name=name, requests=Resources.make(cpu=cpu, memory=mem), **kw)
+
+
+# --------------------------------------------------------------------------- #
+# v1 JSON round-trip
+# --------------------------------------------------------------------------- #
+
+
+def test_v1_pod_roundtrip():
+    pod = Pod(
+        name="web-0", namespace="prod", uid="u-123",
+        labels={"app": "web", "tier": "fe"},
+        requests=Resources.make(cpu="1500m", memory="2Gi"),
+        node_selector={"disktype": "ssd"},
+        affinity=Affinity(
+            anti_required=(PodAffinityTerm(
+                selector=LabelSelector.of({"app": "web"}),
+                topology_key="kubernetes.io/hostname"),),
+        ),
+        tolerations=(Toleration(key="gpu", op=TolerationOp.EXISTS,
+                                effect=TaintEffect.NO_SCHEDULE),),
+        priority=100,
+    )
+    rt = pod_from_v1(pod_to_v1(pod))
+    assert rt.key == pod.key and rt.uid == "u-123"
+    assert rt.requests.milli_cpu == 1500
+    assert rt.requests.memory_kib == 2 * 1024 * 1024
+    assert rt.node_selector == {"disktype": "ssd"}
+    assert rt.affinity.anti_required[0].topology_key == "kubernetes.io/hostname"
+    assert rt.tolerations[0].op == TolerationOp.EXISTS
+    assert rt.priority == 100
+
+
+def test_v1_pod_init_container_max_rule():
+    """GetResourceRequest (predicates.go:763): Σ containers, max initContainers."""
+    obj = {
+        "metadata": {"name": "p", "namespace": "d"},
+        "spec": {
+            "containers": [
+                {"name": "a", "resources": {"requests": {"cpu": "200m", "memory": "100Mi"}}},
+                {"name": "b", "resources": {"requests": {"cpu": "300m", "memory": "100Mi"}}},
+            ],
+            "initContainers": [
+                {"name": "init", "resources": {"requests": {"cpu": "1", "memory": "50Mi"}}},
+            ],
+        },
+    }
+    pod = pod_from_v1(obj)
+    assert pod.requests.milli_cpu == 1000  # max(200+300, 1000)
+    assert pod.requests.memory_kib == 200 * 1024  # max(100+100, 50) Mi
+
+
+def test_v1_node_roundtrip():
+    n = Node(name="n0", labels={"zone": "a"},
+             allocatable=Resources.make(cpu=8, memory="16Gi", pods=110),
+             taints=(Taint(key="dedicated", value="ml",
+                           effect=TaintEffect.NO_SCHEDULE),),
+             unschedulable=True)
+    rt = node_from_v1(node_to_v1(n))
+    assert rt.name == "n0" and rt.labels == {"zone": "a"}
+    assert rt.allocatable.milli_cpu == 8000
+    assert rt.taints[0].key == "dedicated"
+    assert rt.unschedulable
+
+
+# --------------------------------------------------------------------------- #
+# in-process backend (FakeExtender rung)
+# --------------------------------------------------------------------------- #
+
+
+def _backend_with_cluster():
+    be = ExtenderBackend()
+    be.sync_nodes([
+        mknode("big", cpu=8),
+        mknode("small", cpu=1),
+        mknode("tainted", cpu=8,
+               taints=(Taint(key="dedicated", value="x",
+                             effect=TaintEffect.NO_SCHEDULE),)),
+    ])
+    return be
+
+
+def test_backend_filter_cache_capable():
+    be = _backend_with_cluster()
+    args = ExtenderArgs(
+        pod=pod_to_v1(mkpod("p", cpu="2")),
+        node_names=["big", "small", "tainted", "ghost"],
+    )
+    res = be.filter(args)
+    assert res.node_names == ["big"]
+    assert "small" in res.failed_nodes and "Insufficient" in res.failed_nodes["small"]
+    assert "taint" in res.failed_nodes["tainted"]
+    assert res.failed_nodes["ghost"] == "node not found in extender cache"
+
+
+def test_backend_filter_full_nodes_mode():
+    """nodeCacheCapable=false: full v1.Node objects in, subset out."""
+    be = ExtenderBackend()
+    args = ExtenderArgs(
+        pod=pod_to_v1(mkpod("p", cpu="2")),
+        nodes=[node_to_v1(mknode("a", cpu=8)), node_to_v1(mknode("b", cpu=1))],
+    )
+    res = be.filter(args)
+    assert [n["metadata"]["name"] for n in res.nodes] == ["a"]
+    assert "b" in res.failed_nodes
+
+
+def test_backend_prioritize_prefers_empty_node():
+    be = ExtenderBackend()
+    be.sync_nodes([mknode("empty", cpu=8), mknode("busy", cpu=8)])
+    busy_pod = mkpod("occupant", cpu="6")
+    busy_pod.node_name = "busy"
+    be.sync_scheduled_pods([busy_pod])
+    prios = be.prioritize(ExtenderArgs(
+        pod=pod_to_v1(mkpod("p", cpu="1")), node_names=["empty", "busy"]))
+    scores = {p.host: p.score for p in prios}
+    assert scores["empty"] > scores["busy"]
+    assert 0 <= scores["busy"] <= 10 and scores["empty"] <= 10
+
+
+def test_backend_preemption_verifies_victims():
+    be = ExtenderBackend()
+    be.sync_nodes([mknode("n0", cpu=2)])
+    victim = mkpod("victim", cpu="1500m")
+    victim.node_name = "n0"
+    be.sync_scheduled_pods([victim])
+
+    from kubernetes_tpu.extender.wire import ExtenderPreemptionArgs, Victims
+
+    # removing the victim makes room → node survives with the victim set
+    args = ExtenderPreemptionArgs(
+        pod=pod_to_v1(mkpod("p", cpu="1")),
+        node_name_to_victims={"n0": Victims(pods=[pod_to_v1(victim)])},
+    )
+    res = be.process_preemption(args)
+    assert "n0" in res.node_name_to_meta_victims
+
+    # empty victim set but the pod doesn't fit → node dropped
+    args2 = ExtenderPreemptionArgs(
+        pod=pod_to_v1(mkpod("p2", cpu="1")),
+        node_name_to_victims={"n0": Victims(pods=[])},
+    )
+    res2 = be.process_preemption(args2)
+    assert "n0" not in res2.node_name_to_meta_victims
+
+
+# --------------------------------------------------------------------------- #
+# real HTTP (httptest rung)
+# --------------------------------------------------------------------------- #
+
+
+def test_http_extender_end_to_end():
+    be = _backend_with_cluster()
+    with ExtenderServer(be) as srv:
+        cfg = ExtenderConfig(
+            url_prefix=srv.url, filter_verb="filter", prioritize_verb="prioritize",
+            preempt_verb="preemption", bind_verb="bind", weight=2,
+            node_cache_capable=True,
+        )
+        ext = HTTPExtender(cfg)
+        nodes = [mknode("big", cpu=8), mknode("small", cpu=1)]
+
+        passing, failed = ext.filter(mkpod("p", cpu="2"), nodes)
+        assert passing == ["big"] and "small" in failed
+
+        scores, weight = ext.prioritize(mkpod("p", cpu="2"), nodes)
+        assert weight == 2 and set(scores) == {"big", "small"}
+
+        ext.bind(mkpod("p", cpu="2"), "big")
+        assert be.bound == [("default/p", "big")]
+    assert srv.requests_served == 3
+
+
+def test_http_server_speaks_reference_wire_format():
+    """Byte-level check: a raw POST shaped like the Go HTTPExtender's
+    (capitalized JSON keys) gets a correctly shaped reply."""
+    be = ExtenderBackend()
+    be.sync_nodes([mknode("n0", cpu=4)])
+    with ExtenderServer(be) as srv:
+        payload = json.dumps({
+            "Pod": pod_to_v1(mkpod("p", cpu="1")),
+            "NodeNames": ["n0"],
+            "Nodes": None,
+        }).encode()
+        req = urllib.request.Request(
+            srv.url + "/filter", data=payload,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            out = json.loads(resp.read())
+        assert out["NodeNames"] == ["n0"]
+        assert out["FailedNodes"] == {} and out["Error"] == ""
+
+        # healthz (server.go:216-227 analog)
+        with urllib.request.urlopen(srv.url.rsplit("/", 1)[0] + "/healthz") as resp:
+            assert resp.read() == b"ok"
+
+
+def test_http_extender_ignorable_and_managed_resources():
+    cfg = ExtenderConfig(url_prefix="http://127.0.0.1:1/dead", filter_verb="filter",
+                         managed_resources=("example.com/tpu",), ignorable=True)
+    ext = HTTPExtender(cfg)
+    assert not ext.is_interested(mkpod("plain"))
+    rich = mkpod("rich")
+    rich.requests = Resources(milli_cpu=100, scalars=(("example.com/tpu", 4),))
+    assert ext.is_interested(rich)
+
+
+# --------------------------------------------------------------------------- #
+# our scheduler calling OUT to extenders (HTTPExtender client in the cycle)
+# --------------------------------------------------------------------------- #
+
+
+def test_scheduler_with_extender_in_cycle():
+    """A second ExtenderBackend acts as the external webhook; our Scheduler
+    consults it per pod: its filter veto and its bind verb both take effect."""
+    from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+
+    # external extender that only admits node "allowed"
+    class VetoBackend(ExtenderBackend):
+        def filter(self, args):
+            res = super().filter(args)
+            keep = [n for n in (res.node_names or []) if n == "allowed"]
+            res.node_names = keep
+            return res
+
+    ext_be = VetoBackend()
+    ext_be.sync_nodes([mknode("allowed", cpu=8), mknode("forbidden", cpu=8)])
+
+    with ExtenderServer(ext_be) as srv:
+        cfg = ExtenderConfig(url_prefix=srv.url, filter_verb="filter",
+                             prioritize_verb="prioritize", bind_verb="bind",
+                             node_cache_capable=True)
+        binder = RecordingBinder()
+        s = Scheduler(binder=binder, extenders=[HTTPExtender(cfg)])
+        s.on_node_add(mknode("allowed", cpu=8))
+        s.on_node_add(mknode("forbidden", cpu=8))
+        for i in range(3):
+            s.on_pod_add(mkpod(f"p{i}", cpu="1"))
+        stats = s.schedule_pending()
+        assert stats.scheduled == 3
+        # every pod landed on the only extender-approved node, bound via the
+        # extender's bind verb (not the local binder)
+        assert all(n == "allowed" for _, n in ext_be.bound)
+        assert len(ext_be.bound) == 3 and binder.bound == []
