@@ -133,8 +133,8 @@ class ExtenderBackend:
     # verb: Filter
     # ------------------------------------------------------------------ #
 
-    def _snapshot_for(self, pod: Pod):
-        snap = self.cache.snapshot(
+    def _snapshot_for(self, pod: Pod, cache: Optional[SchedulerCache] = None):
+        snap = (cache or self.cache).snapshot(
             self.encoder, [pod], self.base_dims,
             extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
         )
@@ -256,26 +256,25 @@ class ExtenderBackend:
                 for node, v in args.node_name_to_victims.items():
                     victims_by_node[node] = [pod_from_v1(p).key for p in v.pods]
 
+            # NOTE: one what-if dispatch per candidate node (victim sets differ
+            # per node, so the existing-pod arrays differ). This verb is the
+            # reference's own cold path — the scheduler calls it once per
+            # preemption attempt, not per cycle. The in-process preemptor
+            # (ops/preempt.py) batches its what-ifs on device instead.
             result: Dict[str, MetaVictims] = {}
             all_scheduled = {p.key: p for p in self.cache.scheduled_pods()}
             key_to_uid = {p.key: p.uid for p in all_scheduled.values()}
             for node_name, victim_keys in victims_by_node.items():
-                # what-if: evaluate feasibility with the victims removed
-                keep = [p for k, p in all_scheduled.items() if k not in set(victim_keys)]
+                gone = set(victim_keys)
+                keep = [p for k, p in all_scheduled.items() if k not in gone]
                 probe = SchedulerCache()
                 for n in self.cache.nodes():
                     probe.add_node(n)
                 for p in keep:
                     probe.add_pod(p)
-                snap = probe.snapshot(
-                    self.encoder, [pod], self.base_dims,
-                    extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
-                )
-                self.encoder.vocabs.label_vals.intern("")
-                uk = jnp.int32(self.encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
-                ev = jnp.int32(self.encoder.vocabs.label_vals.get(""))
+                snap, keys = self._snapshot_for(pod, cache=probe)
                 mask = jax.device_get(_feasible(
-                    snap.tables, snap.pending, (uk, ev), snap.dims.D, snap.existing
+                    snap.tables, snap.pending, keys, snap.dims.D, snap.existing
                 ))[0]
                 try:
                     i = snap.node_order.index(node_name)

@@ -1,0 +1,238 @@
+"""In-tree framework plugins — the tensor re-expression of
+pkg/scheduler/framework/plugins/* wrapping the lattice ops.
+
+Each filter plugin selects its per-predicate component from the shared
+MaskComponents decomposition (computed once per fused cycle); each score
+plugin returns a 0..100-normalized [P, N] tensor. Plugin names match the
+reference's registry keys (framework/plugins/default_registry.go:57) so
+Plugins configs written for the reference map 1:1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.assign import mask_components
+from ..ops.fit import resource_scores_row
+from ..ops.interpod import soft_affinity_row
+from ..ops.lattice import build_cycle
+from .interface import (
+    CycleState,
+    FilterPlugin,
+    Plugin,
+    ScorePlugin,
+    TensorContext,
+)
+from .runtime import Framework, Plugins, PluginSet, Registry
+
+
+def build_context(tables, existing, pending, uk, ev, D) -> TensorContext:
+    """Assemble the TensorContext for one fused cycle (PreFilter device half:
+    build_cycle = GetPredicateMetadata analog, metadata.go:334)."""
+    cyc = build_cycle(tables, existing, uk, ev, D)
+    ctx = TensorContext(tables=tables, cyc=cyc, pending=pending)
+    comp = mask_components(tables, cyc, pending)
+    return ctx._replace(components=comp)
+
+
+# --------------------------------------------------------------------------- #
+# Filter plugins (framework/plugins/<dir>; predicates.go semantics)
+# --------------------------------------------------------------------------- #
+
+
+class NodeResourcesFit(FilterPlugin):
+    """noderesources/fit.go — PodFitsResources (predicates.go:789)."""
+
+    def filter_mask(self, state: CycleState, ctx: TensorContext):
+        return ctx.components.fit
+
+
+class NodeAffinity(FilterPlugin):
+    """nodeaffinity/ — PodMatchNodeSelector (predicates.go:914): spec.nodeSelector
+    ∧ required node affinity."""
+
+    def filter_mask(self, state: CycleState, ctx: TensorContext):
+        return ctx.components.node_match
+
+
+class NodeName(FilterPlugin):
+    """nodename/ — PodFitsHost (predicates.go:926)."""
+
+    def filter_mask(self, state: CycleState, ctx: TensorContext):
+        return ctx.components.host
+
+
+class NodePorts(FilterPlugin):
+    """nodeports/ — PodFitsHostPorts (predicates.go:1104)."""
+
+    def filter_mask(self, state: CycleState, ctx: TensorContext):
+        return ctx.components.ports
+
+
+class TaintToleration(FilterPlugin, ScorePlugin):
+    """tainttoleration/ — PodToleratesNodeTaints (predicates.go:1543) filter +
+    PreferNoSchedule-counting score (taint_toleration.go)."""
+
+    def filter_mask(self, state: CycleState, ctx: TensorContext):
+        return ctx.components.taints
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        return ctx.cyc.static.taint_score[ctx.pending.cls]
+
+
+class NodeUnschedulable(FilterPlugin):
+    """nodeunschedulable/ — CheckNodeUnschedulable (predicates.go:1522).
+    Evaluated jointly with taints in the lattice (spec.unschedulable is the
+    synthetic node.kubernetes.io/unschedulable taint); the shared component
+    keeps both names live for config parity."""
+
+    def filter_mask(self, state: CycleState, ctx: TensorContext):
+        return ctx.components.taints
+
+
+class InterPodAffinity(FilterPlugin, ScorePlugin):
+    """interpodaffinity/ — MatchInterPodAffinity (predicates.go:1212) filter +
+    soft (anti)affinity score (interpod_affinity.go:119-215)."""
+
+    def filter_mask(self, state: CycleState, ctx: TensorContext):
+        return ctx.components.affinity & ctx.components.anti
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        tables, cyc = ctx.tables, ctx.cyc
+        D = cyc.ELD.shape[2] - 1
+        return jax.vmap(
+            lambda c: soft_affinity_row(
+                c, tables.classes, tables.terms, cyc.CNT, tables.nodes, D)
+        )(ctx.pending.cls)
+
+
+class PodTopologySpread(FilterPlugin):
+    """podtopologyspread/ — EvenPodsSpreadPredicate (predicates.go:1643)."""
+
+    def filter_mask(self, state: CycleState, ctx: TensorContext):
+        return ctx.components.spread
+
+
+# --------------------------------------------------------------------------- #
+# Score plugins
+# --------------------------------------------------------------------------- #
+
+
+class _ResourceScoreBase(ScorePlugin):
+    _index = 0  # 0 = least, 1 = balanced
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        tables = ctx.tables
+
+        def row(c):
+            req_vec = tables.reqs.vec[tables.classes.rid[c]]
+            return resource_scores_row(req_vec, tables.nodes.used, tables.nodes.alloc)
+
+        pair = jax.vmap(row)(ctx.pending.cls)
+        return pair[self._index]
+
+
+class NodeResourcesLeastAllocated(_ResourceScoreBase):
+    """noderesources/least_allocated.go — spread by free capacity."""
+
+    _index = 0
+
+
+class NodeResourcesBalancedAllocation(_ResourceScoreBase):
+    """noderesources/balanced_allocation.go — minimize cpu/mem fraction skew."""
+
+    _index = 1
+
+
+class NodeResourcesMostAllocated(ScorePlugin):
+    """noderesources/most_allocated.go — bin-packing: (total/cap)×100 averaged
+    over cpu+memory (most_requested.go:60 semantics)."""
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        tables = ctx.tables
+
+        def row(c):
+            req_vec = tables.reqs.vec[tables.classes.rid[c]]
+            total = tables.nodes.used + req_vec[None, :]
+            cap = tables.nodes.alloc
+            def frac(t, cp):
+                f = t.astype(jnp.float32) / jnp.maximum(cp.astype(jnp.float32), 1.0)
+                return jnp.where((cp > 0) & (t <= cp), f * 100.0, 0.0)
+            return (frac(total[:, 0], cap[:, 0]) + frac(total[:, 1], cap[:, 1])) / 2.0
+
+        return jax.vmap(row)(ctx.pending.cls)
+
+
+class NodePreferAvoidPods(ScorePlugin):
+    """nodepreferavoidpods/ — nodes annotated avoid-pods score 0, others 100
+    (node_prefer_avoid_pods.go). The annotation rides NodeArrays.avoid."""
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        avoid = getattr(ctx.tables.nodes, "avoid", None)
+        N = ctx.tables.nodes.valid.shape[0]
+        P = ctx.pending.valid.shape[0]
+        if avoid is None:
+            return jnp.full((P, N), 100.0, jnp.float32)
+        return jnp.where(avoid[None, :], 0.0, 100.0).astype(jnp.float32)
+
+
+class NodeAffinityScore(ScorePlugin):
+    """nodeaffinity preferred terms score (priorities/node_affinity.go:34)."""
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        return ctx.cyc.static.pref_score[ctx.pending.cls]
+
+
+# --------------------------------------------------------------------------- #
+# registry + defaults (default_registry.go:57 NewDefaultRegistry)
+# --------------------------------------------------------------------------- #
+
+
+def default_registry() -> Registry:
+    return {
+        "NodeResourcesFit": lambda cfg: NodeResourcesFit(),
+        "NodeAffinity": lambda cfg: NodeAffinity(),
+        "NodeName": lambda cfg: NodeName(),
+        "NodePorts": lambda cfg: NodePorts(),
+        "NodeUnschedulable": lambda cfg: NodeUnschedulable(),
+        "TaintToleration": lambda cfg: TaintToleration(),
+        "InterPodAffinity": lambda cfg: InterPodAffinity(),
+        "PodTopologySpread": lambda cfg: PodTopologySpread(),
+        "NodeResourcesLeastAllocated": lambda cfg: NodeResourcesLeastAllocated(),
+        "NodeResourcesBalancedAllocation": lambda cfg: NodeResourcesBalancedAllocation(),
+        "NodeResourcesMostAllocated": lambda cfg: NodeResourcesMostAllocated(),
+        "NodePreferAvoidPods": lambda cfg: NodePreferAvoidPods(),
+        "NodeAffinityScore": lambda cfg: NodeAffinityScore(),
+    }
+
+
+def default_plugins() -> Plugins:
+    """The default provider's plugin set (algorithmprovider/defaults +
+    default_registry.go ConfigProducer mapping)."""
+    return Plugins(
+        filter=PluginSet(enabled=[
+            "NodeUnschedulable", "NodeName", "NodePorts", "NodeAffinity",
+            "NodeResourcesFit", "TaintToleration", "InterPodAffinity",
+            "PodTopologySpread",
+        ]),
+        score=PluginSet(enabled=[
+            "NodeResourcesLeastAllocated", "NodeResourcesBalancedAllocation",
+            "NodeAffinityScore", "TaintToleration", "InterPodAffinity",
+        ]),
+    )
+
+
+def default_framework(
+    plugins: Optional[Plugins] = None,
+    plugin_config: Optional[dict] = None,
+    score_weights: Optional[dict] = None,
+) -> Framework:
+    return Framework(
+        registry=default_registry(),
+        plugins=plugins or default_plugins(),
+        plugin_config=plugin_config,
+        score_weights=score_weights,
+    )

@@ -29,8 +29,9 @@ from .topospread import eligible_domains
 class StaticLattice(NamedTuple):
     mask: Array        # [SC, N] — static Filter conjunction
     node_match: Array  # [SC, N] — nodeSelector ∧ node-affinity only (spread eligibility)
-    score: Array       # [SC, N] f32 — static Score sum (preferred node affinity,
-                       #   taint PreferNoSchedule), already 0..100-normalized per part
+    score: Array       # [SC, N] f32 — static Score sum (pref_score + taint_score)
+    pref_score: Array  # [SC, N] f32 — preferred node affinity, 0..100-normalized
+    taint_score: Array # [SC, N] f32 — taint PreferNoSchedule score, 0..100
 
 
 class CycleArrays(NamedTuple):
@@ -89,7 +90,9 @@ def build_static(
 
     taint_score = taint_toleration_score(prefer_cnt[ts])  # [SC, N]
 
-    return StaticLattice(mask=mask, node_match=node_match, score=pref_score + taint_score)
+    return StaticLattice(mask=mask, node_match=node_match,
+                         score=pref_score + taint_score,
+                         pref_score=pref_score, taint_score=taint_score)
 
 
 def build_cycle(

@@ -84,6 +84,7 @@ class Scheduler:
         clock: Callable[[], float] = time.monotonic,
         preemptor: Optional["object"] = None,
         extenders: Sequence["object"] = (),
+        framework: Optional["object"] = None,
     ) -> None:
         self.binder = binder
         self.cache = cache or SchedulerCache()
@@ -99,6 +100,14 @@ class Scheduler:
         # path (`_schedule_one_with_extenders`) — the extender protocol is
         # per-pod HTTP anyway, so the reference's own round-trip cost applies.
         self.extenders = list(extenders)
+        # Framework host lifecycle points (Reserve/Permit/PreBind/Bind/
+        # PostBind/Unreserve) guard the commit path (scheduler.go:660-762).
+        # The device-evaluated points run inside the fused cycle; None keeps
+        # the plain fast path.
+        self.framework = framework
+        # key → (attempts, CycleState, node_name, original pod, binder_ext)
+        self._waiting_meta: Dict[str, Tuple] = {}
+        self.waiting_bind_errors = 0  # bind failures on the waiting-release path
 
     # ------------------------------------------------------------------ #
     # event handlers (eventhandlers.go)
@@ -153,6 +162,18 @@ class Scheduler:
     # the scheduling wave
     # ------------------------------------------------------------------ #
 
+    def _snapshot_keys(self, pending: List[Pod]):
+        """Snapshot + the interned synthetic-taint key ids every dispatch
+        needs (single home for the UNSCHEDULABLE_TAINT_KEY interning ritual)."""
+        snap = self.cache.snapshot(
+            self.encoder, pending, self.base_dims,
+            extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
+        )
+        self.encoder.vocabs.label_vals.intern("")
+        uk = jnp.int32(self.encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+        ev = jnp.int32(self.encoder.vocabs.label_vals.get(""))
+        return snap, (uk, ev)
+
     def schedule_pending(self, now: Optional[float] = None) -> CycleStats:
         """One wave: pump → pop batch → snapshot → device cycle → commit.
 
@@ -163,6 +184,7 @@ class Scheduler:
         t0 = time.perf_counter()
         self.queue.pump(now)
         self.cache.cleanup(now)
+        self.expire_waiting(now)
         batch = self.queue.pop_batch(self.batch_size, now=now)
         cycle = self.queue.current_cycle()
         stats = CycleStats(attempted=len(batch))
@@ -185,14 +207,8 @@ class Scheduler:
             return stats
 
         pending = [p for p, _ in batch]
-        snap = self.cache.snapshot(
-            self.encoder, pending, self.base_dims,
-            extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
-        )
-        self.encoder.vocabs.label_vals.intern("")
-        uk = jnp.int32(self.encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
-        ev = jnp.int32(self.encoder.vocabs.label_vals.get(""))
-        res = _schedule_batch(snap.tables, snap.pending, (uk, ev), snap.dims.D,
+        snap, keys = self._snapshot_keys(pending)
+        res = _schedule_batch(snap.tables, snap.pending, keys, snap.dims.D,
                               snap.existing)
         node_idx = jax.device_get(res.node)
 
@@ -208,22 +224,7 @@ class Scheduler:
                 # confirmation) — do not double-assume
                 continue
             node_name = snap.node_order[ni]
-            self.cache.assume_pod(pod, node_name)
-            self.queue.delete_nominated(pod.key)
-            ok = False
-            try:
-                ok = self.binder.bind(pod, node_name)
-            except Exception:
-                ok = False
-            if ok:
-                self.cache.finish_binding(pod.key, now)
-                stats.scheduled += 1
-                stats.assignments[pod.key] = node_name
-            else:
-                # rollback + retry (scheduler.go:717,732 → ForgetPod)
-                self.cache.forget_pod(pod.key)
-                stats.bind_errors += 1
-                self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
+            self._commit(pod, node_name, attempts, now, cycle, stats)
 
         # ---- preemption pass: AFTER commits, against a FRESH snapshot so the
         # what-if sees pods assumed earlier in this very wave (otherwise a
@@ -259,16 +260,10 @@ class Scheduler:
         if self.cache.get_pod(pod.key) is not None:
             return  # stale queue entry (skipPodSchedule)
 
-        snap = self.cache.snapshot(
-            self.encoder, [pod], self.base_dims,
-            extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
-        )
-        self.encoder.vocabs.label_vals.intern("")
-        uk = jnp.int32(self.encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
-        ev = jnp.int32(self.encoder.vocabs.label_vals.get(""))
+        snap, keys = self._snapshot_keys([pod])
         # one dispatch: infeasible nodes are -inf in the score matrix
         raw = jax.device_get(_scores(
-            snap.tables, snap.pending, (uk, ev), snap.dims.D, snap.existing))[0]
+            snap.tables, snap.pending, keys, snap.dims.D, snap.existing))[0]
 
         nodes_by_name = {n.name: n for n in self.cache.nodes()}
         feasible: List[str] = []
@@ -314,27 +309,171 @@ class Scheduler:
             return
 
         best = max(feasible, key=lambda n: combined.get(n, float("-inf")))
-        self.cache.assume_pod(pod, best)
-        self.queue.delete_nominated(pod.key)
         binder_ext = next(
             (e for e in self.extenders if e.is_binder and e.is_interested(pod)), None)
+        self._commit(pod, best, attempts, now, cycle, stats, binder_ext=binder_ext)
+
+    # ------------------------------------------------------------------ #
+    # commit path: assume → Reserve → Permit → PreBind → Bind → PostBind
+    # (scheduler.go:660-762)
+    # ------------------------------------------------------------------ #
+
+    def _commit(
+        self,
+        pod: Pod,
+        node_name: str,
+        attempts: int,
+        now: float,
+        cycle: int,
+        stats: CycleStats,
+        binder_ext: Optional["object"] = None,
+    ) -> None:
+        fw = self.framework
+        state = None
+        self.cache.assume_pod(pod, node_name)
+        self.queue.delete_nominated(pod.key)
+
+        def rollback(as_bind_error: bool) -> None:
+            # scheduler.go:717,732 — Unreserve + ForgetPod + requeue
+            if fw is not None and state is not None:
+                fw.run_unreserve_plugins(state, pod, node_name)
+            self.cache.forget_pod(pod.key)
+            if as_bind_error:
+                stats.bind_errors += 1
+            else:
+                stats.unschedulable += 1
+            self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
+
+        if fw is not None:
+            from ..framework.interface import Code, CycleState
+
+            state = CycleState()
+            st = fw.run_reserve_plugins(state, pod, node_name)  # scheduler.go:669
+            if st is not None and not st.is_success:
+                rollback(as_bind_error=False)
+                return
+            st = fw.run_permit_plugins(state, pod, node_name)   # scheduler.go:707
+            if st.code == Code.WAIT:
+                # pod parks assumed in the waiting map; complete_waiting()
+                # finishes the bind when permit plugins allow it. Keep the
+                # ORIGINAL (unstamped) pod for requeue-on-failure — the cached
+                # copy carries node_name and would pin retries to this node.
+                self._waiting_meta[pod.key] = (attempts, state, node_name,
+                                               pod, binder_ext)
+                return
+            if not st.is_success:
+                rollback(as_bind_error=False)
+                return
+            st = fw.run_pre_bind_plugins(state, pod, node_name)  # scheduler.go:727
+            if st is not None and not st.is_success:
+                rollback(as_bind_error=True)
+                return
+
         ok = False
         try:
-            if binder_ext is not None:
-                binder_ext.bind(pod, best)
+            if fw is not None and state is not None:
+                from ..framework.interface import Code
+
+                bst = fw.run_bind_plugins(state, pod, node_name)  # scheduler.go:741
+                if bst.code == Code.SKIP:
+                    ok = (binder_ext.bind(pod, node_name) or True) if binder_ext \
+                        else self.binder.bind(pod, node_name)
+                else:
+                    ok = bst.is_success
+            elif binder_ext is not None:
+                binder_ext.bind(pod, node_name)
                 ok = True
             else:
-                ok = self.binder.bind(pod, best)
+                ok = self.binder.bind(pod, node_name)
         except Exception:
             ok = False
+
         if ok:
             self.cache.finish_binding(pod.key, now)
             stats.scheduled += 1
-            stats.assignments[pod.key] = best
+            stats.assignments[pod.key] = node_name
+            if fw is not None and state is not None:
+                fw.run_post_bind_plugins(state, pod, node_name)
         else:
-            self.cache.forget_pod(pod.key)
-            stats.bind_errors += 1
-            self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
+            rollback(as_bind_error=True)
+
+    def complete_waiting(self, key: str, now: Optional[float] = None) -> bool:
+        """Finish the bind for a pod released from the Permit waiting map
+        (frameworkHandle.IterateOverWaitingPods → Allow flow). Call after
+        framework.allow_waiting_pod returns True."""
+        now = self.clock() if now is None else now
+        meta = self._waiting_meta.pop(key, None)
+        if meta is None:
+            return False
+        attempts, state, node_name, pod, binder_ext = meta
+        if self.cache.get_pod(key) is None:
+            return False
+        fw = self.framework
+        st = fw.run_pre_bind_plugins(state, pod, node_name)
+        ok = False
+        if st is None or st.is_success:
+            from ..framework.interface import Code
+
+            bst = fw.run_bind_plugins(state, pod, node_name)
+            try:
+                if bst.code == Code.SKIP:
+                    if binder_ext is not None:
+                        binder_ext.bind(pod, node_name)
+                        ok = True
+                    else:
+                        ok = self.binder.bind(pod, node_name)
+                else:
+                    ok = bst.is_success
+            except Exception:
+                ok = False
+        if ok:
+            self.cache.finish_binding(key, now)
+            fw.run_post_bind_plugins(state, pod, node_name)
+            return True
+        self.waiting_bind_errors += 1
+        fw.run_unreserve_plugins(state, pod, node_name)
+        self.cache.forget_pod(key)
+        self.queue.add_unschedulable(pod, attempts, now, cycle=self.queue.current_cycle())
+        return False
+
+    def reject_waiting(self, key: str, now: Optional[float] = None) -> bool:
+        """Reject a Permit-waiting pod (WaitingPod.Reject flow): unreserve,
+        forget the assume, requeue for retry."""
+        if self.framework is None:
+            return False
+        now = self.clock() if now is None else now
+        w = self.framework.pop_waiting(key)
+        meta = self._waiting_meta.pop(key, None)
+        if w is None and meta is None:
+            return False
+        attempts = meta[0] if meta else 0
+        pod = meta[3] if meta else w.pod
+        state = meta[1] if meta else w.state
+        node_name = meta[2] if meta else w.node_name
+        self.framework.run_unreserve_plugins(state, pod, node_name)
+        if self.cache.is_assumed(key):
+            self.cache.forget_pod(key)
+        self.queue.add_unschedulable(pod, attempts, now,
+                                     cycle=self.queue.current_cycle())
+        return True
+
+    def expire_waiting(self, now: Optional[float] = None) -> int:
+        """Reject Permit-waiting pods past their deadline: unreserve, forget,
+        requeue (waiting_pods_map timeout semantics)."""
+        if self.framework is None:
+            return 0
+        now = self.clock() if now is None else now
+        expired = self.framework.expire_waiting(now)
+        for w in expired:
+            meta = self._waiting_meta.pop(w.pod.key, None)
+            attempts = meta[0] if meta else 0
+            pod = meta[3] if meta else w.pod  # original unstamped pod
+            self.framework.run_unreserve_plugins(w.state, pod, w.node_name)
+            if self.cache.is_assumed(w.pod.key):
+                self.cache.forget_pod(w.pod.key)
+            self.queue.add_unschedulable(pod, attempts, now,
+                                         cycle=self.queue.current_cycle())
+        return len(expired)
 
     def run_until_idle(self, max_waves: int = 100) -> CycleStats:
         """Drive waves until the active queue drains (integration-test helper;

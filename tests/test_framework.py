@@ -1,0 +1,283 @@
+"""Framework runtime tests: fused plugin evaluation parity with the monolithic
+lattice, custom plugins, and the host lifecycle points (Reserve/Permit/Bind)
+— the shape of framework_test.go + integration/scheduler/framework_test.go."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.api.types import Node, Pod, Resources
+from kubernetes_tpu.framework import (
+    Code,
+    CycleState,
+    FilterPlugin,
+    Framework,
+    PermitPlugin,
+    Plugins,
+    PluginSet,
+    BindPlugin,
+    ReservePlugin,
+    ScorePlugin,
+    Status,
+    UnreservePlugin,
+    build_context,
+    default_framework,
+    default_plugins,
+    default_registry,
+)
+from kubernetes_tpu.sched.cycle import (
+    UNSCHEDULABLE_TAINT_KEY,
+    _feasible,
+    _scores,
+)
+from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+from kubernetes_tpu.state.encode import Encoder
+
+
+def mknode(name, cpu=4, mem="8Gi", **kw):
+    return Node(name=name, allocatable=Resources.make(cpu=cpu, memory=mem, pods=110),
+                **kw)
+
+
+def mkpod(name, cpu="500m", mem="256Mi", **kw):
+    return Pod(name=name, requests=Resources.make(cpu=cpu, memory=mem), **kw)
+
+
+def _encode(nodes, existing, pending):
+    enc = Encoder()
+    enc.vocabs.label_keys.intern(UNSCHEDULABLE_TAINT_KEY)
+    enc.vocabs.label_vals.intern("")
+    tables, ex, pe, d = enc.encode_cluster(nodes, existing, pending, None)
+    uk = jnp.int32(enc.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+    ev = jnp.int32(enc.vocabs.label_vals.get(""))
+    return (jax.device_put(tables), jax.device_put(ex), jax.device_put(pe),
+            d, (uk, ev))
+
+
+def test_default_framework_matches_monolithic_lattice():
+    """The fused AND/Σ over the default in-tree plugins must equal the
+    monolithic _feasible/_scores kernels bit for bit."""
+    nodes = [mknode(f"n{i}", cpu=2 + i) for i in range(5)]
+    existing = []
+    pending = [mkpod("a", cpu="1"), mkpod("b", cpu="6")]
+    tables, ex, pe, d, keys = _encode(nodes, existing, pending)
+
+    fw = default_framework()
+    state = CycleState()
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def fused(tables, pending, keys, D, existing):
+        ctx = build_context(tables, existing, pending, keys[0], keys[1], D)
+        return fw.run_filter_plugins(state, ctx), fw.run_score_plugins(state, ctx)
+
+    mask_fw, score_fw = jax.device_get(fused(tables, pe, keys, d.D, ex))
+    mask_ref = jax.device_get(_feasible(tables, pe, keys, d.D, ex))
+    score_ref = jax.device_get(_scores(tables, pe, keys, d.D, ex))
+
+    np.testing.assert_array_equal(mask_fw, mask_ref)
+    # _scores is -inf on infeasible; compare on feasible entries only
+    np.testing.assert_allclose(
+        np.where(mask_ref, score_fw, 0.0),
+        np.where(mask_ref, score_ref, 0.0), rtol=1e-5)
+
+
+def test_custom_filter_plugin_vetoes():
+    class OnlyFirstNode(FilterPlugin):
+        def filter_mask(self, state, ctx):
+            N = ctx.tables.nodes.valid.shape[0]
+            P = ctx.pending.valid.shape[0]
+            return (jnp.arange(N) == 0)[None, :] & jnp.ones((P, 1), bool)
+
+    reg = dict(default_registry(), OnlyFirstNode=lambda cfg: OnlyFirstNode())
+    plugins = default_plugins()
+    plugins.filter.enabled.append("OnlyFirstNode")
+    fw = Framework(registry=reg, plugins=plugins)
+
+    nodes = [mknode(f"n{i}") for i in range(4)]
+    pending = [mkpod("a")]
+    tables, ex, pe, d, keys = _encode(nodes, [], pending)
+    ctx = build_context(tables, ex, pe, keys[0], keys[1], d.D)
+    mask = jax.device_get(fw.run_filter_plugins(CycleState(), ctx))
+    assert mask[0, 0] and not mask[0, 1:].any()
+
+
+def test_score_plugin_weighting():
+    class ConstantScore(ScorePlugin):
+        def score_matrix(self, state, ctx):
+            P = ctx.pending.valid.shape[0]
+            N = ctx.tables.nodes.valid.shape[0]
+            return jnp.full((P, N), 10.0)
+
+    reg = {"Const": lambda cfg: ConstantScore()}
+    fw = Framework(registry=reg,
+                   plugins=Plugins(score=PluginSet(enabled=["Const"])),
+                   score_weights={"Const": 3})
+    nodes = [mknode("n0")]
+    tables, ex, pe, d, keys = _encode(nodes, [], [mkpod("a")])
+    ctx = build_context(tables, ex, pe, keys[0], keys[1], d.D)
+    score = jax.device_get(fw.run_score_plugins(CycleState(), ctx))
+    assert float(score[0, 0]) == 30.0
+
+
+def test_permit_wait_allow_and_timeout():
+    """Permit WAIT parks the pod assumed; Allow releases and binds; timeout
+    rejects back to the queue (waiting_pods_map semantics)."""
+    class Gate(PermitPlugin):
+        def permit(self, state, pod, node):
+            return Status(Code.WAIT), 30.0
+
+    class FakeClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    reg = {"Gate": lambda cfg: Gate()}
+    fw = Framework(registry=reg, plugins=Plugins(permit=PluginSet(enabled=["Gate"])),
+                   clock=clock)
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder, framework=fw, clock=clock)
+    s.on_node_add(mknode("n0"))
+    s.on_pod_add(mkpod("w"))
+    stats = s.schedule_pending()
+    assert stats.scheduled == 0 and binder.bound == []
+    assert [p.key for p in fw.waiting_pods()] == ["default/w"]
+    assert s.cache.is_assumed("default/w")
+
+    # allow → released → bind completes
+    released = fw.allow_waiting_pod("default/w", "Gate")
+    assert released
+    assert s.complete_waiting("default/w")
+    assert binder.bound == [("default/w", "n0")]
+
+    # second pod: let it time out instead
+    s.on_pod_add(mkpod("t"))
+    s.schedule_pending()
+    assert [p.key for p in fw.waiting_pods()] == ["default/t"]
+    clock.t = 100.0
+    assert s.expire_waiting() == 1
+    assert not s.cache.is_assumed("default/t")
+    # back in a retry queue, not lost
+    assert s.queue.lengths()[1] + s.queue.lengths()[2] >= 1
+
+
+def test_reserve_failure_rolls_back():
+    calls = []
+
+    class BadReserve(ReservePlugin):
+        def reserve(self, state, pod, node):
+            return Status(Code.ERROR, "volume attach failed")
+
+    class Undo(UnreservePlugin):
+        def unreserve(self, state, pod, node):
+            calls.append(pod.key)
+
+    reg = {"BadReserve": lambda cfg: BadReserve(), "Undo": lambda cfg: Undo()}
+    fw = Framework(registry=reg, plugins=Plugins(
+        reserve=PluginSet(enabled=["BadReserve"]),
+        unreserve=PluginSet(enabled=["Undo"])))
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder, framework=fw)
+    s.on_node_add(mknode("n0"))
+    s.on_pod_add(mkpod("p"))
+    stats = s.schedule_pending()
+    assert stats.scheduled == 0 and stats.unschedulable == 1
+    assert calls == ["default/p"]
+    assert not s.cache.is_assumed("default/p")
+
+
+def test_bind_plugin_overrides_binder():
+    bound = []
+
+    class MyBinder(BindPlugin):
+        def bind(self, state, pod, node):
+            bound.append((pod.key, node))
+            return None  # success
+
+    reg = {"MyBinder": lambda cfg: MyBinder()}
+    fw = Framework(registry=reg,
+                   plugins=Plugins(bind=PluginSet(enabled=["MyBinder"])))
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder, framework=fw)
+    s.on_node_add(mknode("n0"))
+    s.on_pod_add(mkpod("p"))
+    stats = s.schedule_pending()
+    assert stats.scheduled == 1
+    assert bound == [("default/p", "n0")]
+    assert binder.bound == []  # default API binder skipped
+
+
+def test_waiting_bind_failure_requeues_unpinned():
+    """Regression: a bind failure after Permit release must requeue the
+    ORIGINAL pod, not the cache's node_name-stamped copy (which would pin
+    retries to the failed node via PodFitsHost)."""
+    class Gate(PermitPlugin):
+        calls = 0
+
+        def permit(self, state, pod, node):
+            Gate.calls += 1
+            if Gate.calls == 1:
+                return Status(Code.WAIT), 30.0
+            return None, 0.0  # allow on retry
+
+    class FakeClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    reg = {"Gate": lambda cfg: Gate()}
+    fw = Framework(registry=reg, plugins=Plugins(permit=PluginSet(enabled=["Gate"])),
+                   clock=clock)
+    binder = RecordingBinder(fail_keys=["default/w"])
+    s = Scheduler(binder=binder, framework=fw, clock=clock)
+    s.on_node_add(mknode("n0"))
+    s.on_node_add(mknode("n1"))
+    s.on_pod_add(mkpod("w"))
+    s.schedule_pending()
+    fw.allow_waiting_pod("default/w", "Gate")
+    assert not s.complete_waiting("default/w")
+    assert s.waiting_bind_errors == 1
+    # drain backoff and let it schedule anywhere once the binder works
+    binder.fail_keys.clear()
+    clock.t = 100.0
+    s.queue.move_all_to_active(clock.t)
+    stats = s.schedule_pending()
+    assert stats.scheduled == 1
+    assert binder.bound[0][0] == "default/w"
+
+
+def test_reject_waiting_pod_cleans_up():
+    """Regression: rejecting a waiting pod must unreserve + forget + requeue,
+    not strand it assumed."""
+    undone = []
+
+    class Gate(PermitPlugin):
+        def permit(self, state, pod, node):
+            return Status(Code.WAIT), 30.0
+
+    class Undo(UnreservePlugin):
+        def unreserve(self, state, pod, node):
+            undone.append(pod.key)
+
+    reg = {"Gate": lambda cfg: Gate(), "Undo": lambda cfg: Undo()}
+    fw = Framework(registry=reg, plugins=Plugins(
+        permit=PluginSet(enabled=["Gate"]),
+        unreserve=PluginSet(enabled=["Undo"])))
+    s = Scheduler(binder=RecordingBinder(), framework=fw)
+    s.on_node_add(mknode("n0"))
+    s.on_pod_add(mkpod("r"))
+    s.schedule_pending()
+    assert s.cache.is_assumed("default/r")
+    assert s.reject_waiting("default/r")
+    assert undone == ["default/r"]
+    assert not s.cache.is_assumed("default/r")
+    assert not fw.waiting_pods()
+    # pod is queued for retry, not lost
+    assert sum(s.queue.lengths()) >= 1
