@@ -134,14 +134,10 @@ class ExtenderBackend:
     # ------------------------------------------------------------------ #
 
     def _snapshot_for(self, pod: Pod, cache: Optional[SchedulerCache] = None):
-        snap = (cache or self.cache).snapshot(
-            self.encoder, [pod], self.base_dims,
-            extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
-        )
-        self.encoder.vocabs.label_vals.intern("")
-        uk = jnp.int32(self.encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
-        ev = jnp.int32(self.encoder.vocabs.label_vals.get(""))
-        return snap, (uk, ev)
+        from ..sched.cycle import snapshot_with_keys
+
+        return snapshot_with_keys(cache or self.cache, self.encoder, [pod],
+                                  self.base_dims)
 
     def filter(self, args: ExtenderArgs) -> ExtenderFilterResult:
         with self._mu:

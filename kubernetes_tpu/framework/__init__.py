@@ -26,7 +26,7 @@ from .plugins import (
     default_plugins,
     default_registry,
 )
-from .runtime import Framework, PluginSet, Plugins, Registry
+from .runtime import Framework, PluginSet, Plugins, Registry, merge_plugins
 
 __all__ = [
     "Code", "CycleState", "FilterPlugin", "MAX_NODE_SCORE", "MIN_NODE_SCORE",
@@ -34,5 +34,5 @@ __all__ = [
     "PreFilterPlugin", "BindPlugin", "ReservePlugin", "ScorePlugin", "Status",
     "SUCCESS", "TensorContext", "UnreservePlugin", "build_context",
     "default_framework", "default_plugins", "default_registry", "Framework",
-    "PluginSet", "Plugins", "Registry",
+    "PluginSet", "Plugins", "Registry", "merge_plugins",
 ]

@@ -176,7 +176,8 @@ class NodePreferAvoidPods(ScorePlugin):
         P = ctx.pending.valid.shape[0]
         if avoid is None:
             return jnp.full((P, N), 100.0, jnp.float32)
-        return jnp.where(avoid[None, :], 0.0, 100.0).astype(jnp.float32)
+        return jnp.broadcast_to(
+            jnp.where(avoid[None, :], 0.0, 100.0), (P, N)).astype(jnp.float32)
 
 
 class NodeAffinityScore(ScorePlugin):

@@ -44,7 +44,9 @@ class PluginSet:
     """apis/config Plugins entry: enabled plugin names (+ weight for Score)."""
 
     enabled: List[str] = field(default_factory=list)
-    disabled: List[str] = field(default_factory=list)  # "*" disables all defaults
+    # filters default plugins during merge_plugins(); "*" drops all defaults.
+    # On a hand-built Plugins it filters exact names from `enabled`.
+    disabled: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -63,6 +65,26 @@ class Plugins:
     post_bind: PluginSet = field(default_factory=PluginSet)
     unreserve: PluginSet = field(default_factory=PluginSet)
 
+    POINTS = ("pre_filter", "filter", "post_filter", "score", "reserve",
+              "permit", "pre_bind", "bind", "post_bind", "unreserve")
+
+
+def merge_plugins(defaults: Plugins, custom: Plugins) -> Plugins:
+    """Reference profile merging (apis/config Plugins.Apply): per extension
+    point, custom.disabled filters the defaults ("*" drops them all), then
+    custom.enabled is appended in order."""
+    out = Plugins()
+    for point in Plugins.POINTS:
+        d: PluginSet = getattr(defaults, point)
+        c: PluginSet = getattr(custom, point)
+        if "*" in c.disabled:
+            base: List[str] = []
+        else:
+            base = [n for n in d.enabled if n not in set(c.disabled)]
+        merged = base + [n for n in c.enabled if n not in base]
+        setattr(out, point, PluginSet(enabled=merged))
+    return out
+
 
 # factory: (args: dict) -> Plugin instance
 Registry = Dict[str, Callable[[dict], Plugin]]
@@ -77,7 +99,6 @@ class _WaitingPod:
     state: CycleState
     deadline: float
     pending_plugins: set  # plugin names still to allow
-    rejected: bool = False
 
 
 class Framework:
@@ -107,7 +128,10 @@ class Framework:
             return instances[name]
 
         def pick(ps: PluginSet) -> List[Plugin]:
-            return [get(n) for n in ps.enabled]
+            # ps.disabled is resolved against defaults by merge_plugins();
+            # here it still filters exact names so a hand-built Plugins
+            # behaves as documented
+            return [get(n) for n in ps.enabled if n not in set(ps.disabled)]
 
         self.pre_filter_plugins: List[PreFilterPlugin] = pick(plugins.pre_filter)
         self.filter_plugins: List[FilterPlugin] = pick(plugins.filter)

@@ -1,0 +1,21 @@
+"""API machinery: the object-model substrate shared by every component.
+
+TPU-native analog of `staging/src/k8s.io/apimachinery/` (SURVEY.md layer 2).
+The control plane here operates on *dict-shaped versioned objects* — the JSON
+wire form is the in-memory form — rather than generated Go structs; a Scheme
+registers kinds with defaulting/validation, and this package supplies the
+meta/label/quantity/watch/error vocabulary everything else shares.
+
+Modules:
+  meta      — TypeMeta/ObjectMeta accessors (apimachinery pkg/apis/meta/v1)
+  labels    — label Selector parse + match (apimachinery pkg/labels/selector.go)
+  quantity  — resource.Quantity parse/format/arithmetic (pkg/api/resource)
+  scheme    — kind registry + JSON codec (pkg/runtime Scheme/codec)
+  watch     — watch.Event types (pkg/watch)
+  errors    — api/errors Status error taxonomy → HTTP codes
+  wait      — util/wait poll/backoff helpers
+"""
+
+from kubernetes_tpu.machinery import errors, labels, meta, quantity, scheme, wait, watch
+
+__all__ = ["errors", "labels", "meta", "quantity", "scheme", "wait", "watch"]

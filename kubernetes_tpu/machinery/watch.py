@@ -1,0 +1,85 @@
+"""Watch event types and channels.
+
+Analog of apimachinery `pkg/watch/watch.go`: an Interface delivering a stream
+of {type, object} events. Here a watch is a closeable blocking queue; the
+storage layer and clients share this shape.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, Optional
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"
+ERROR = "ERROR"
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str
+    object: Dict[str, Any]
+
+
+class Watch:
+    """watch.Interface: ResultChan() + Stop(). Iteration ends on Stop or when
+    the producer closes the stream."""
+
+    _SENTINEL = object()
+
+    def __init__(self, capacity: int = 1024):
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=capacity)
+        self._stopped = threading.Event()
+
+    def send(self, event: Event, timeout: Optional[float] = 5.0) -> bool:
+        """Producer side. Returns False if the watcher is gone/slow: the
+        reference terminates slow watchers (cacher.go forgetWatcher) rather
+        than blocking the event path."""
+        if self._stopped.is_set():
+            return False
+        try:
+            if timeout is not None and timeout <= 0:
+                self._q.put_nowait(event)
+            else:
+                self._q.put(event, timeout=timeout)
+            return True
+        except queue.Full:
+            self.stop()
+            return False
+
+    def stop(self) -> None:
+        if not self._stopped.is_set():
+            self._stopped.set()
+            try:
+                self._q.put_nowait(self._SENTINEL)
+            except queue.Full:
+                pass
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    def __iter__(self) -> Iterator[Event]:
+        while True:
+            item = self._q.get()
+            if item is self._SENTINEL:
+                return
+            yield item
+            if self._stopped.is_set() and self._q.empty():
+                return
+
+    def next(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """Blocking pop; None on stop/timeout."""
+        if self._stopped.is_set() and self._q.empty():
+            return None
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if item is self._SENTINEL:
+            return None
+        return item

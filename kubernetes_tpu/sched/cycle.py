@@ -28,6 +28,18 @@ from ..state.encode import Encoder
 UNSCHEDULABLE_TAINT_KEY = "node.kubernetes.io/unschedulable"  # predicates.go:1522-1541
 
 
+def snapshot_with_keys(cache, encoder: Encoder, pending, base_dims):
+    """Snapshot + the interned synthetic-taint key ids every device dispatch
+    needs — the single home for the UNSCHEDULABLE_TAINT_KEY interning ritual
+    (shared by the scheduler wave path and the extender backend)."""
+    snap = cache.snapshot(encoder, pending, base_dims,
+                          extra_intern=(UNSCHEDULABLE_TAINT_KEY,))
+    encoder.vocabs.label_vals.intern("")
+    uk = jnp.int32(encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+    ev = jnp.int32(encoder.vocabs.label_vals.get(""))
+    return snap, (uk, ev)
+
+
 @functools.partial(jax.jit, static_argnums=(3,))
 def _schedule_batch(
     tables: ClusterTables,

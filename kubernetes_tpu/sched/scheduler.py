@@ -146,6 +146,17 @@ class Scheduler:
             self.queue.move_all_to_active(self.clock())
         else:
             self.queue.delete(pod.key)
+            # a pod parked in the Permit waiting map is assumed in the cache;
+            # deletion must unwind that state, not leave it to expire into a
+            # requeue of a pod that no longer exists
+            meta = self._waiting_meta.pop(pod.key, None)
+            if meta is not None:
+                _, state, node_name, orig, _ = meta
+                if self.framework is not None:
+                    self.framework.pop_waiting(pod.key)
+                    self.framework.run_unreserve_plugins(state, orig, node_name)
+                if self.cache.is_assumed(pod.key):
+                    self.cache.forget_pod(pod.key)
 
     def on_node_add(self, node: Node) -> None:
         self.cache.add_node(node)
@@ -163,16 +174,10 @@ class Scheduler:
     # ------------------------------------------------------------------ #
 
     def _snapshot_keys(self, pending: List[Pod]):
-        """Snapshot + the interned synthetic-taint key ids every dispatch
-        needs (single home for the UNSCHEDULABLE_TAINT_KEY interning ritual)."""
-        snap = self.cache.snapshot(
-            self.encoder, pending, self.base_dims,
-            extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
-        )
-        self.encoder.vocabs.label_vals.intern("")
-        uk = jnp.int32(self.encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
-        ev = jnp.int32(self.encoder.vocabs.label_vals.get(""))
-        return snap, (uk, ev)
+        from .cycle import snapshot_with_keys
+
+        return snapshot_with_keys(self.cache, self.encoder, pending,
+                                  self.base_dims)
 
     def schedule_pending(self, now: Optional[float] = None) -> CycleStats:
         """One wave: pump → pop batch → snapshot → device cycle → commit.
@@ -352,41 +357,24 @@ class Scheduler:
             if st is not None and not st.is_success:
                 rollback(as_bind_error=False)
                 return
+            # Pre-register the waiting metadata BEFORE the permit plugins run:
+            # run_permit_plugins publishes a WAITing pod in the framework's
+            # cross-thread waiting map, and a permit controller may allow +
+            # complete_waiting() in that window — the meta must already be
+            # there to consume. Keep the ORIGINAL (unstamped) pod for
+            # requeue-on-failure — the cached copy carries node_name and
+            # would pin retries to this node. dict.pop is the atomic
+            # exactly-one-consumer handoff.
+            self._waiting_meta[pod.key] = (attempts, state, node_name,
+                                           pod, binder_ext)
             st = fw.run_permit_plugins(state, pod, node_name)   # scheduler.go:707
             if st.code == Code.WAIT:
-                # pod parks assumed in the waiting map; complete_waiting()
-                # finishes the bind when permit plugins allow it. Keep the
-                # ORIGINAL (unstamped) pod for requeue-on-failure — the cached
-                # copy carries node_name and would pin retries to this node.
-                self._waiting_meta[pod.key] = (attempts, state, node_name,
-                                               pod, binder_ext)
-                return
+                return  # parked (or already completed by a racing allow)
+            self._waiting_meta.pop(pod.key, None)
             if not st.is_success:
                 rollback(as_bind_error=False)
                 return
-            st = fw.run_pre_bind_plugins(state, pod, node_name)  # scheduler.go:727
-            if st is not None and not st.is_success:
-                rollback(as_bind_error=True)
-                return
-
-        ok = False
-        try:
-            if fw is not None and state is not None:
-                from ..framework.interface import Code
-
-                bst = fw.run_bind_plugins(state, pod, node_name)  # scheduler.go:741
-                if bst.code == Code.SKIP:
-                    ok = (binder_ext.bind(pod, node_name) or True) if binder_ext \
-                        else self.binder.bind(pod, node_name)
-                else:
-                    ok = bst.is_success
-            elif binder_ext is not None:
-                binder_ext.bind(pod, node_name)
-                ok = True
-            else:
-                ok = self.binder.bind(pod, node_name)
-        except Exception:
-            ok = False
+        ok = self._run_bind(state, pod, node_name, binder_ext)
 
         if ok:
             self.cache.finish_binding(pod.key, now)
@@ -396,6 +384,29 @@ class Scheduler:
                 fw.run_post_bind_plugins(state, pod, node_name)
         else:
             rollback(as_bind_error=True)
+
+    def _run_bind(self, state, pod: Pod, node_name: str,
+                  binder_ext: Optional["object"]) -> bool:
+        """The shared PreBind → Bind tail of the commit sequence
+        (scheduler.go:727-741). Everything — including raising plugins — is
+        contained here so both callers roll back identically on failure."""
+        fw = self.framework
+        try:
+            if fw is not None and state is not None:
+                from ..framework.interface import Code
+
+                st = fw.run_pre_bind_plugins(state, pod, node_name)
+                if st is not None and not st.is_success:
+                    return False
+                bst = fw.run_bind_plugins(state, pod, node_name)
+                if bst.code != Code.SKIP:
+                    return bst.is_success
+            if binder_ext is not None:
+                binder_ext.bind(pod, node_name)
+                return True
+            return self.binder.bind(pod, node_name)
+        except Exception:
+            return False
 
     def complete_waiting(self, key: str, now: Optional[float] = None) -> bool:
         """Finish the bind for a pod released from the Permit waiting map
@@ -409,23 +420,7 @@ class Scheduler:
         if self.cache.get_pod(key) is None:
             return False
         fw = self.framework
-        st = fw.run_pre_bind_plugins(state, pod, node_name)
-        ok = False
-        if st is None or st.is_success:
-            from ..framework.interface import Code
-
-            bst = fw.run_bind_plugins(state, pod, node_name)
-            try:
-                if bst.code == Code.SKIP:
-                    if binder_ext is not None:
-                        binder_ext.bind(pod, node_name)
-                        ok = True
-                    else:
-                        ok = self.binder.bind(pod, node_name)
-                else:
-                    ok = bst.is_success
-            except Exception:
-                ok = False
+        ok = self._run_bind(state, pod, node_name, binder_ext)
         if ok:
             self.cache.finish_binding(key, now)
             fw.run_post_bind_plugins(state, pod, node_name)

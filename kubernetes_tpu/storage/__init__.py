@@ -1,0 +1,16 @@
+"""Persistence layer: native MVCC kvstore + storage.Interface.
+
+TPU-native analog of SURVEY.md layer 1 (etcd + the apiserver's etcd3 storage,
+staging/src/k8s.io/apiserver/pkg/storage/etcd3/). The store itself is C++
+(native/kvstore.cpp) behind a ctypes binding, with a pure-Python fallback.
+"""
+
+from kubernetes_tpu.storage.native import (
+    CompactedError,
+    NativeKV,
+    PyKV,
+    new_kv,
+)
+from kubernetes_tpu.storage.store import Storage
+
+__all__ = ["CompactedError", "NativeKV", "PyKV", "new_kv", "Storage"]

@@ -1,0 +1,311 @@
+"""ctypes binding to the native MVCC kvstore, with auto-build + fallback.
+
+The C++ store (native/kvstore.cpp) plays the role etcd plays under the
+reference apiserver (storage/etcd3/store.go). `PyKV` is a pure-Python replica
+of the same interface for environments without a C++ toolchain; both are
+exercised by the same tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+
+EVENT_PUT = 0
+EVENT_DELETE = 1
+EVENT_CREATE = 2
+
+
+@dataclass(frozen=True)
+class KVRecord:
+    key: str
+    value: bytes
+    create_rev: int
+    mod_rev: int
+
+
+@dataclass(frozen=True)
+class KVEvent:
+    rev: int
+    type: int  # EVENT_PUT | EVENT_DELETE | EVENT_CREATE
+    key: str
+    value: bytes  # for DELETE: the previous value
+
+
+class CompactedError(Exception):
+    """Watch/list from a revision older than the compaction point."""
+
+
+def _build_lib() -> Optional[str]:
+    so = os.path.join(_NATIVE_DIR, "libkvstore.so")
+    if os.path.exists(so):
+        return so
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return so if os.path.exists(so) else None
+    except Exception:
+        return None
+
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _load_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    with _lib_lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        so = _build_lib()
+        if not so:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.kv_new.restype = ctypes.c_void_p
+        lib.kv_free.argtypes = [ctypes.c_void_p]
+        for fn, args, res in [
+            ("kv_rev", [ctypes.c_void_p], ctypes.c_int64),
+            ("kv_compacted_rev", [ctypes.c_void_p], ctypes.c_int64),
+            ("kv_put", [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                        ctypes.c_int64], ctypes.c_int64),
+            ("kv_txn_put", [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                            ctypes.c_char_p, ctypes.c_int64], ctypes.c_int64),
+            ("kv_txn_delete", [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int64], ctypes.c_int64),
+            ("kv_get", [ctypes.c_void_p, ctypes.c_char_p,
+                        ctypes.POINTER(ctypes.c_char_p),
+                        ctypes.POINTER(ctypes.c_int64),
+                        ctypes.POINTER(ctypes.c_int64),
+                        ctypes.POINTER(ctypes.c_int64)], ctypes.c_int64),
+            ("kv_range", [ctypes.c_void_p, ctypes.c_char_p,
+                          ctypes.POINTER(ctypes.c_char_p),
+                          ctypes.POINTER(ctypes.c_int64),
+                          ctypes.POINTER(ctypes.c_int64)], ctypes.c_int64),
+            ("kv_count", [ctypes.c_void_p, ctypes.c_char_p], ctypes.c_int64),
+            ("kv_events_since", [ctypes.c_void_p, ctypes.c_int64,
+                                 ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_char_p),
+                                 ctypes.POINTER(ctypes.c_int64)], ctypes.c_int64),
+            ("kv_wait", [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64],
+             ctypes.c_int64),
+            ("kv_compact", [ctypes.c_void_p, ctypes.c_int64], ctypes.c_int64),
+            ("kv_buf_free", [ctypes.c_char_p], None),
+        ]:
+            f = getattr(lib, fn)
+            f.argtypes = args
+            f.restype = res
+        _lib = lib
+        return _lib
+
+
+def _parse_records(buf: bytes) -> List[Tuple[int, int, str, bytes]]:
+    """Decode [i64 a][i64 b][i64 klen][key][i64 vlen][val]* records."""
+    out = []
+    off, n = 0, len(buf)
+    while off < n:
+        a, b, klen = struct.unpack_from("<qqq", buf, off)
+        off += 24
+        key = buf[off:off + klen].decode()
+        off += klen
+        (vlen,) = struct.unpack_from("<q", buf, off)
+        off += 8
+        val = buf[off:off + vlen]
+        off += vlen
+        out.append((a, b, key, val))
+    return out
+
+
+class NativeKV:
+    """The C++ store. All revisions are int; value payloads are bytes."""
+
+    def __init__(self) -> None:
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native kvstore unavailable")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.kv_new())
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kv_free(self._h)
+            self._h = None
+
+    def rev(self) -> int:
+        return int(self._lib.kv_rev(self._h))
+
+    def compacted_rev(self) -> int:
+        return int(self._lib.kv_compacted_rev(self._h))
+
+    def put(self, key: str, value: bytes) -> int:
+        return int(self._lib.kv_put(self._h, key.encode(), value, len(value)))
+
+    def txn_put(self, key: str, expected_mod_rev: int, value: bytes) -> int:
+        """expected 0=create-only, >0=CAS on mod_rev, -1=unconditional.
+        Returns new rev or -1 on condition failure."""
+        return int(self._lib.kv_txn_put(self._h, key.encode(),
+                                        expected_mod_rev, value, len(value)))
+
+    def txn_delete(self, key: str, expected_mod_rev: int = -1) -> int:
+        """Returns new rev, 0 if absent, -1 on condition failure."""
+        return int(self._lib.kv_txn_delete(self._h, key.encode(),
+                                           expected_mod_rev))
+
+    def get(self, key: str) -> Optional[KVRecord]:
+        out = ctypes.c_char_p()
+        out_len = ctypes.c_int64()
+        crev = ctypes.c_int64()
+        mrev = ctypes.c_int64()
+        found = self._lib.kv_get(self._h, key.encode(), ctypes.byref(out),
+                                 ctypes.byref(out_len), ctypes.byref(crev),
+                                 ctypes.byref(mrev))
+        if not found:
+            return None
+        try:
+            val = ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.kv_buf_free(out)
+        return KVRecord(key, val, crev.value, mrev.value)
+
+    def range(self, prefix: str) -> Tuple[List[KVRecord], int]:
+        out = ctypes.c_char_p()
+        out_len = ctypes.c_int64()
+        at_rev = ctypes.c_int64()
+        self._lib.kv_range(self._h, prefix.encode(), ctypes.byref(out),
+                           ctypes.byref(out_len), ctypes.byref(at_rev))
+        try:
+            buf = ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.kv_buf_free(out)
+        recs = [KVRecord(k, v, a, b) for a, b, k, v in _parse_records(buf)]
+        return recs, at_rev.value
+
+    def count(self, prefix: str) -> int:
+        return int(self._lib.kv_count(self._h, prefix.encode()))
+
+    def events_since(self, since_rev: int, prefix: str = "") -> List[KVEvent]:
+        out = ctypes.c_char_p()
+        out_len = ctypes.c_int64()
+        n = self._lib.kv_events_since(self._h, since_rev, prefix.encode(),
+                                      ctypes.byref(out), ctypes.byref(out_len))
+        if n < 0:
+            raise CompactedError(f"revision {since_rev} already compacted")
+        try:
+            buf = ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.kv_buf_free(out)
+        return [KVEvent(rev, typ, k, v) for rev, typ, k, v in _parse_records(buf)]
+
+    def wait(self, rev: int, timeout: float) -> int:
+        return int(self._lib.kv_wait(self._h, rev, int(timeout * 1000)))
+
+    def compact(self, at_rev: int) -> int:
+        return int(self._lib.kv_compact(self._h, at_rev))
+
+
+class PyKV:
+    """Pure-Python replica of NativeKV (same interface, same semantics)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Condition()
+        self._data: dict = {}  # key -> (value, create_rev, mod_rev)
+        self._events: List[KVEvent] = []
+        self._rev = 0
+        self._compacted = 0
+
+    def close(self) -> None:
+        pass
+
+    def rev(self) -> int:
+        with self._mu:
+            return self._rev
+
+    def compacted_rev(self) -> int:
+        with self._mu:
+            return self._compacted
+
+    def put(self, key: str, value: bytes) -> int:
+        return self.txn_put(key, -1, value)
+
+    def txn_put(self, key: str, expected_mod_rev: int, value: bytes) -> int:
+        with self._mu:
+            cur = self._data.get(key)
+            if expected_mod_rev == 0 and cur is not None:
+                return -1
+            if expected_mod_rev > 0 and (cur is None or cur[2] != expected_mod_rev):
+                return -1
+            self._rev += 1
+            create = cur[1] if cur else self._rev
+            self._data[key] = (value, create, self._rev)
+            self._events.append(KVEvent(
+                self._rev, EVENT_PUT if cur else EVENT_CREATE, key, value))
+            self._mu.notify_all()
+            return self._rev
+
+    def txn_delete(self, key: str, expected_mod_rev: int = -1) -> int:
+        with self._mu:
+            cur = self._data.get(key)
+            if cur is None:
+                return 0
+            if expected_mod_rev > 0 and cur[2] != expected_mod_rev:
+                return -1
+            self._rev += 1
+            del self._data[key]
+            self._events.append(KVEvent(self._rev, EVENT_DELETE, key, cur[0]))
+            self._mu.notify_all()
+            return self._rev
+
+    def get(self, key: str) -> Optional[KVRecord]:
+        with self._mu:
+            cur = self._data.get(key)
+            if cur is None:
+                return None
+            return KVRecord(key, cur[0], cur[1], cur[2])
+
+    def range(self, prefix: str) -> Tuple[List[KVRecord], int]:
+        with self._mu:
+            recs = [KVRecord(k, v[0], v[1], v[2])
+                    for k, v in sorted(self._data.items())
+                    if k.startswith(prefix)]
+            return recs, self._rev
+
+    def count(self, prefix: str) -> int:
+        with self._mu:
+            return sum(1 for k in self._data if k.startswith(prefix))
+
+    def events_since(self, since_rev: int, prefix: str = "") -> List[KVEvent]:
+        with self._mu:
+            if since_rev < self._compacted:
+                raise CompactedError(f"revision {since_rev} already compacted")
+            return [e for e in self._events
+                    if e.rev > since_rev and e.key.startswith(prefix)]
+
+    def wait(self, rev: int, timeout: float) -> int:
+        with self._mu:
+            self._mu.wait_for(lambda: self._rev > rev, timeout=timeout)
+            return self._rev
+
+    def compact(self, at_rev: int) -> int:
+        with self._mu:
+            self._events = [e for e in self._events if e.rev > at_rev]
+            if at_rev > self._compacted:
+                self._compacted = at_rev
+            return self._compacted
+
+
+def new_kv(prefer_native: bool = True):
+    """Factory: native store if buildable, else the Python replica."""
+    if prefer_native:
+        try:
+            return NativeKV()
+        except RuntimeError:
+            pass
+    return PyKV()

@@ -1,0 +1,219 @@
+"""storage.Interface: versioned object storage over the MVCC kvstore.
+
+Analog of `staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go`: objects
+are JSON-encoded under `/registry/<resource>/[<ns>/]<name>`; as in the
+reference, resourceVersion is NOT stored in the value — it is filled from the
+record's mod_revision on every read (store.go Versioner). GuaranteedUpdate
+retries a CAS on mod_revision (store.go:219-300); Watch delivers events from
+a given revision with 410-Gone on compaction. One dispatcher thread pumps kv
+events to all registered watchers (role of etcd watch streams + the apiserver
+Cacher, storage/cacher/cacher.go:309).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.machinery import errors, meta
+from kubernetes_tpu.machinery import watch as mwatch
+from kubernetes_tpu.storage import native
+
+Obj = Dict[str, Any]
+Predicate = Optional[Callable[[Obj], bool]]
+
+
+def _encode(obj: Obj) -> bytes:
+    obj = dict(obj)
+    md = dict(obj.get("metadata") or {})
+    md.pop("resourceVersion", None)
+    obj["metadata"] = md
+    return json.dumps(obj, separators=(",", ":"), sort_keys=True).encode()
+
+
+def _decode(data: bytes, rev: int) -> Obj:
+    obj = json.loads(data)
+    meta.set_resource_version(obj, str(rev))
+    return obj
+
+
+class Storage:
+    """Object store + watch hub over one KV backend."""
+
+    def __init__(self, kv=None):
+        self.kv = kv if kv is not None else native.new_kv()
+        self._watch_mu = threading.Lock()
+        self._watchers: List[Tuple[str, mwatch.Watch, Predicate]] = []
+        self._dispatched_rev = self.kv.rev()
+        self._stop = threading.Event()
+        self._pump = threading.Thread(target=self._dispatch_loop,
+                                      name="storage-watch-pump", daemon=True)
+        self._pump.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._pump.join(timeout=2)
+        with self._watch_mu:
+            for _, w, _ in self._watchers:
+                w.stop()
+            self._watchers.clear()
+        self.kv.close()
+
+    # ------------------------------------------------------------------ #
+    # CRUD (etcd3 store.go Create:143 / Get:86 / Delete / GuaranteedUpdate:219)
+    # ------------------------------------------------------------------ #
+
+    def create(self, key: str, obj: Obj, resource: str = "object") -> Obj:
+        rev = self.kv.txn_put(key, 0, _encode(obj))
+        if rev < 0:
+            raise errors.new_already_exists(resource, meta.name(obj))
+        out = meta.deep_copy(obj)
+        meta.set_resource_version(out, str(rev))
+        return out
+
+    def get(self, key: str, resource: str = "object", name: str = "") -> Obj:
+        rec = self.kv.get(key)
+        if rec is None:
+            raise errors.new_not_found(resource, name or key)
+        return _decode(rec.value, rec.mod_rev)
+
+    def list(self, prefix: str, predicate: Predicate = None) -> Tuple[List[Obj], str]:
+        recs, at_rev = self.kv.range(prefix)
+        items = []
+        for rec in recs:
+            obj = _decode(rec.value, rec.mod_rev)
+            if predicate is None or predicate(obj):
+                items.append(obj)
+        return items, str(at_rev)
+
+    def count(self, prefix: str) -> int:
+        return self.kv.count(prefix)
+
+    def delete(self, key: str, resource: str = "object", name: str = "",
+               expected_rv: Optional[str] = None) -> Obj:
+        while True:
+            rec = self.kv.get(key)
+            if rec is None:
+                raise errors.new_not_found(resource, name or key)
+            if expected_rv is not None and str(rec.mod_rev) != expected_rv:
+                raise errors.new_conflict(resource, name or key,
+                                          "the object has been modified")
+            rv = self.kv.txn_delete(key, rec.mod_rev)
+            if rv > 0:
+                return _decode(rec.value, rec.mod_rev)
+            if rv == 0:
+                raise errors.new_not_found(resource, name or key)
+            # lost a race with a concurrent update; retry
+
+    def guaranteed_update(self, key: str, update_fn: Callable[[Obj], Obj],
+                          resource: str = "object", name: str = "",
+                          ignore_not_found: bool = False,
+                          expected_rv: Optional[str] = None) -> Obj:
+        """Retry loop: read → user transform → CAS write (store.go:219-300).
+
+        update_fn receives a deep copy (with resourceVersion set) and returns
+        the new object, or raises to abort.
+        """
+        first = True
+        while True:
+            rec = self.kv.get(key)
+            if rec is None:
+                if not ignore_not_found:
+                    raise errors.new_not_found(resource, name or key)
+                cur: Obj = {}
+                cur_mod = 0
+            else:
+                cur = _decode(rec.value, rec.mod_rev)
+                cur_mod = rec.mod_rev
+            if (first and expected_rv is not None and rec is not None
+                    and str(rec.mod_rev) != expected_rv):
+                raise errors.new_conflict(
+                    resource, name or key,
+                    "the object has been modified; please apply your changes "
+                    "to the latest version and try again")
+            first = False
+            updated = update_fn(meta.deep_copy(cur))
+            rev = self.kv.txn_put(key, cur_mod if cur_mod else 0, _encode(updated))
+            if rev > 0:
+                out = meta.deep_copy(updated)
+                meta.set_resource_version(out, str(rev))
+                return out
+            # CAS failure → re-read and retry
+
+    # ------------------------------------------------------------------ #
+    # Watch
+    # ------------------------------------------------------------------ #
+
+    def watch(self, prefix: str, since_rv: str = "",
+              predicate: Predicate = None) -> mwatch.Watch:
+        """Watch events under prefix with revision > since_rv.
+
+        since_rv ""/"0" = from now. Raises Gone(410) if since_rv predates
+        compaction — the caller must relist (reflector relist semantics).
+        """
+        w = mwatch.Watch(capacity=8192)
+        with self._watch_mu:
+            since = int(since_rv) if since_rv not in ("", "0") else self._dispatched_rev
+            # catch-up: replay history before going live under the same lock
+            # the pump uses, so no event is missed or duplicated
+            try:
+                history = self.kv.events_since(since, prefix)
+            except native.CompactedError:
+                raise errors.new_gone(
+                    f"too old resource version: {since} "
+                    f"(compacted at {self.kv.compacted_rev()})")
+            for ev in history:
+                if ev.rev > self._dispatched_rev:
+                    break  # the pump will deliver the rest
+                self._send(w, ev, predicate)
+            self._watchers.append((prefix, w, predicate))
+        return w
+
+    @staticmethod
+    def _send(w: mwatch.Watch, ev: native.KVEvent, predicate: Predicate,
+              timeout: float = 0.0) -> None:
+        obj = _decode(ev.value, ev.rev)
+        if predicate is not None and not predicate(obj):
+            return
+        typ = {native.EVENT_CREATE: mwatch.ADDED,
+               native.EVENT_PUT: mwatch.MODIFIED,
+               native.EVENT_DELETE: mwatch.DELETED}[ev.type]
+        # non-blocking from the dispatcher: a watcher that cannot keep up is
+        # terminated (send stops it on Full), never allowed to stall the
+        # event path for everyone else (cacher.go forgetWatcher semantics)
+        w.send(mwatch.Event(typ, obj), timeout=timeout)
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            rev = self.kv.wait(self._dispatched_rev, timeout=0.25)
+            if rev <= self._dispatched_rev:
+                continue
+            try:
+                events = self.kv.events_since(self._dispatched_rev, "")
+            except native.CompactedError:
+                # the pump fell behind compaction: watchers have an
+                # unrecoverable gap — error them all out so clients relist
+                # (the reference terminates such watchers, cacher.go)
+                with self._watch_mu:
+                    gone = errors.new_gone(
+                        "watch events compacted away; relist required")
+                    for _, w, _ in self._watchers:
+                        w.send(mwatch.Event(mwatch.ERROR, gone.status()),
+                               timeout=0)
+                        w.stop()
+                    self._watchers.clear()
+                    self._dispatched_rev = self.kv.rev()
+                continue
+            with self._watch_mu:
+                live = []
+                for prefix, w, pred in self._watchers:
+                    if w.stopped:
+                        continue
+                    live.append((prefix, w, pred))
+                    for ev in events:
+                        if ev.key.startswith(prefix):
+                            self._send(w, ev, pred)
+                self._watchers = live
+                if events:
+                    self._dispatched_rev = max(e.rev for e in events)

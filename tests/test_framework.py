@@ -281,3 +281,95 @@ def test_reject_waiting_pod_cleans_up():
     assert not fw.waiting_pods()
     # pod is queued for retry, not lost
     assert sum(s.queue.lengths()) >= 1
+
+
+def test_deleted_waiting_pod_is_not_resurrected():
+    """Regression: deleting a pod parked in the Permit waiting map must unwind
+    the assume and NOT requeue it on expiry (on_pod_delete waiting cleanup)."""
+    class Gate(PermitPlugin):
+        def permit(self, state, pod, node):
+            return Status(Code.WAIT), 30.0
+
+    class FakeClock:
+        t = 0.0
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    fw = Framework(registry={"Gate": lambda cfg: Gate()},
+                   plugins=Plugins(permit=PluginSet(enabled=["Gate"])),
+                   clock=clock)
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder, framework=fw, clock=clock)
+    s.on_node_add(mknode("n0"))
+    pod = mkpod("doomed")
+    s.on_pod_add(pod)
+    s.schedule_pending()
+    assert s.cache.is_assumed("default/doomed")
+
+    s.on_pod_delete(pod)
+    assert not s.cache.is_assumed("default/doomed")
+    assert fw.waiting_pods() == []
+    clock.t = 100.0
+    assert s.expire_waiting() == 0
+    s.schedule_pending()
+    assert binder.bound == []
+    assert sum(s.queue.lengths()) == 0
+
+
+def test_raising_bind_plugin_in_complete_waiting_rolls_back():
+    """Regression: a bind plugin that RAISES during the waiting-release path
+    must unreserve + forget, identically to the _commit path."""
+    class Gate(PermitPlugin):
+        def permit(self, state, pod, node):
+            return Status(Code.WAIT), 30.0
+
+    class Bomb(BindPlugin):
+        def bind(self, state, pod, node):
+            raise RuntimeError("apiserver down")
+
+    fw = Framework(
+        registry={"Gate": lambda cfg: Gate(), "Bomb": lambda cfg: Bomb()},
+        plugins=Plugins(permit=PluginSet(enabled=["Gate"]),
+                        bind=PluginSet(enabled=["Bomb"])))
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder, framework=fw)
+    s.on_node_add(mknode("n0"))
+    s.on_pod_add(mkpod("w"))
+    s.schedule_pending()
+    fw.allow_waiting_pod("default/w", "Gate")
+    assert not s.complete_waiting("default/w")
+    assert not s.cache.is_assumed("default/w")      # assume rolled back
+    assert s.waiting_bind_errors == 1
+    assert sum(s.queue.lengths()) == 1               # requeued for retry
+
+
+def test_merge_plugins_disabled_semantics():
+    from kubernetes_tpu.framework import merge_plugins, default_plugins
+
+    defaults = default_plugins()
+    custom = Plugins(score=PluginSet(enabled=["MyScore"],
+                                     disabled=["NodePreferAvoidPods"]),
+                     filter=PluginSet(disabled=["*"], enabled=["OnlyFilter"]))
+    merged = merge_plugins(defaults, custom)
+    assert "MyScore" in merged.score.enabled
+    assert "NodePreferAvoidPods" not in merged.score.enabled
+    # other defaults survive
+    assert any(n != "MyScore" for n in merged.score.enabled)
+    assert merged.filter.enabled == ["OnlyFilter"]
+    # untouched points keep defaults verbatim
+    assert merged.pre_filter.enabled == defaults.pre_filter.enabled
+
+
+def test_node_prefer_avoid_pods_shape():
+    import numpy as np
+    from kubernetes_tpu.framework.plugins import NodePreferAvoidPods
+
+    nodes = [mknode("n0"), mknode("n1")]
+    tables, ex, pe, d, keys = _encode(nodes, [], [mkpod("a"), mkpod("b"), mkpod("c")])
+    if not hasattr(tables.nodes, "avoid") or getattr(tables.nodes, "avoid", None) is None:
+        import pytest
+        pytest.skip("avoid annotation not encoded in this build")
+    ctx = build_context(tables, ex, pe, keys[0], keys[1], d.D)
+    out = NodePreferAvoidPods().score_matrix(CycleState(), ctx)
+    assert out.shape == (pe.valid.shape[0], tables.nodes.valid.shape[0])
