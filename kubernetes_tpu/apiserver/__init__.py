@@ -1,0 +1,12 @@
+"""API server: registry stores + REST engine + HTTP gateway with watch.
+
+TPU-native analog of SURVEY.md layer 4 (`cmd/kube-apiserver`,
+`staging/src/k8s.io/apiserver`, `pkg/registry`).
+"""
+
+from kubernetes_tpu.apiserver.registry import Store, parse_field_selector
+from kubernetes_tpu.apiserver.resources import build_scheme
+from kubernetes_tpu.apiserver.server import APIServer, HTTPGateway, handle_rest
+
+__all__ = ["APIServer", "HTTPGateway", "Store", "build_scheme",
+           "handle_rest", "parse_field_selector"]

@@ -1,0 +1,466 @@
+"""The API server: REST engine + HTTP front end with watch streaming.
+
+Analog of `cmd/kube-apiserver` + the generic apiserver library
+(`staging/src/k8s.io/apiserver/pkg/server/`): a delegation of
+Store-per-resource registries behind one handler chain. The engine
+(`APIServer`) is usable in-process (the integration-test path — the reference
+does the same with its in-process master, `test/integration/framework`);
+`HTTPGateway` serves the same engine over HTTP with chunked watch streams.
+
+Request paths match the reference wire layout:
+    /api/v1/{resource}                              (legacy core group)
+    /api/v1/namespaces/{ns}/{resource}[/{name}[/{sub}]]
+    /apis/{group}/{version}/...
+    /healthz /readyz /livez /version /metrics /api /apis
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from kubernetes_tpu.machinery import errors, meta
+from kubernetes_tpu.machinery import watch as mwatch
+from kubernetes_tpu.machinery.scheme import ResourceInfo, Scheme
+from kubernetes_tpu.apiserver.registry import AdmissionFn, Store
+from kubernetes_tpu.apiserver.resources import build_scheme
+from kubernetes_tpu.storage.store import Storage
+
+Obj = Dict[str, Any]
+
+VERSION_INFO = {
+    "major": "1", "minor": "17+",
+    "gitVersion": "v1.17.0-tpu.1",
+    "platform": "jax/xla-tpu",
+}
+
+
+class APIServer:
+    """The in-process REST engine: one Store per served resource."""
+
+    def __init__(self, storage: Optional[Storage] = None,
+                 admission: Optional[AdmissionFn] = None,
+                 scheme: Optional[Scheme] = None):
+        self.storage = storage or Storage()
+        self.scheme = scheme or build_scheme()
+        self.admission = admission
+        self._stores: Dict[Tuple[str, str], Store] = {}
+        for info in self.scheme.resources():
+            self._install(info)
+        # namespace bookkeeping: ensure default namespaces exist
+        for ns in ("default", "kube-system", "kube-public", "kube-node-lease"):
+            try:
+                self.store("", "namespaces").create("", {
+                    "apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": ns}})
+            except errors.StatusError:
+                pass
+
+    def _install(self, info: ResourceInfo) -> Store:
+        st = Store(self.storage, self.scheme, info, admission=self._admit)
+        self._stores[(info.group, info.resource)] = st
+        return st
+
+    def _admit(self, op: str, info: ResourceInfo, obj: Optional[Obj],
+               old: Optional[Obj]) -> Optional[Obj]:
+        if self.admission is not None:
+            return self.admission(op, info, obj, old)
+        return obj
+
+    def close(self) -> None:
+        self.storage.close()
+
+    # ------------------------------------------------------------------ #
+    # registry access
+    # ------------------------------------------------------------------ #
+
+    def store(self, group: str, resource: str) -> Store:
+        st = self._stores.get((group, resource))
+        if st is None:
+            info = self.scheme.lookup_resource(group, resource)
+            if info is None:
+                raise errors.new_not_found(resource, "")
+            st = self._stores.get((info.group, info.resource))
+            if st is None:
+                raise errors.new_not_found(resource, "")
+        return st
+
+    def register_resource(self, info: ResourceInfo) -> Store:
+        """Dynamic registration (the CRD install path)."""
+        self.scheme.register(info)
+        return self._install(info)
+
+    # ------------------------------------------------------------------ #
+    # subresources (registry/core/pod/storage: BindingREST, StatusREST …)
+    # ------------------------------------------------------------------ #
+
+    def bind_pod(self, namespace: str, name: str, binding: Obj) -> Obj:
+        """POST pods/{name}/binding — the scheduler's terminal write
+        (registry/core/pod/storage/storage.go BindingREST.Create)."""
+        target = (binding.get("target") or {}).get("name", "")
+        if not target:
+            raise errors.new_bad_request("binding.target.name is required")
+        uid_pre = meta.uid(binding)
+
+        def apply(pod: Obj) -> Obj:
+            if not pod:
+                raise errors.new_not_found("pods", name)
+            if uid_pre and meta.uid(pod) != uid_pre:
+                raise errors.new_conflict("pods", name, "uid does not match")
+            if pod.get("spec", {}).get("nodeName"):
+                raise errors.new_conflict(
+                    "pods", name, f'pod is already assigned to node '
+                    f'"{pod["spec"]["nodeName"]}"')
+            pod.setdefault("spec", {})["nodeName"] = target
+            conds = pod.setdefault("status", {}).setdefault("conditions", [])
+            conds.append({"type": "PodScheduled", "status": "True",
+                          "lastTransitionTime": meta.now_rfc3339()})
+            return pod
+
+        return self.store("", "pods").storage.guaranteed_update(
+            self.store("", "pods").key_for(namespace, name), apply,
+            "pods", name)
+
+    def evict_pod(self, namespace: str, name: str, eviction: Obj) -> Obj:
+        """POST pods/{name}/eviction — PDB-gated delete. The PDB check
+        (disruption allowance) rides the admission chain when configured."""
+        if self.admission is not None:
+            pod = self.store("", "pods").get(namespace, name)
+            self.admission("EVICT", self.scheme.lookup_resource("", "pods"),
+                           eviction, pod)
+        return self.store("", "pods").delete(namespace, name)
+
+    def get_scale(self, group: str, resource: str, namespace: str,
+                  name: str) -> Obj:
+        obj = self.store(group, resource).get(namespace, name)
+        return {
+            "apiVersion": "autoscaling/v1", "kind": "Scale",
+            "metadata": {"name": name, "namespace": namespace,
+                         "resourceVersion": meta.resource_version(obj)},
+            "spec": {"replicas": int(obj.get("spec", {}).get("replicas", 0))},
+            "status": {"replicas": int(obj.get("status", {}).get("replicas", 0)),
+                       "selector": ""},
+        }
+
+    def put_scale(self, group: str, resource: str, namespace: str,
+                  name: str, scale: Obj) -> Obj:
+        replicas = int(scale.get("spec", {}).get("replicas", 0))
+
+        def apply(obj: Obj) -> Obj:
+            if not obj:
+                raise errors.new_not_found(resource, name)
+            obj.setdefault("spec", {})["replicas"] = replicas
+            return obj
+
+        st = self.store(group, resource)
+        out = st.storage.guaranteed_update(st.key_for(namespace, name), apply,
+                                           resource, name)
+        return self.get_scale(group, resource, namespace, name)
+
+    def delete_namespace(self, name: str) -> Obj:
+        """Namespace delete = phase Terminating until spec.finalizers empties
+        (registry/core/namespace/storage: Delete + FinalizeREST)."""
+        st = self.store("", "namespaces")
+
+        def mark(o: Obj) -> Obj:
+            if not o:
+                raise errors.new_not_found("namespaces", name)
+            meta.ensure_meta(o)["deletionTimestamp"] = meta.now_rfc3339()
+            o.setdefault("status", {})["phase"] = "Terminating"
+            return o
+
+        out = st.storage.guaranteed_update(st.key_for("", name), mark,
+                                           "namespaces", name)
+        if not out.get("spec", {}).get("finalizers"):
+            return st.storage.delete(st.key_for("", name), "namespaces", name)
+        return out
+
+    def finalize_namespace(self, name: str, ns_obj: Obj) -> Obj:
+        st = self.store("", "namespaces")
+        fins = ns_obj.get("spec", {}).get("finalizers", [])
+
+        def apply(o: Obj) -> Obj:
+            if not o:
+                raise errors.new_not_found("namespaces", name)
+            o.setdefault("spec", {})["finalizers"] = fins
+            return o
+
+        out = st.storage.guaranteed_update(st.key_for("", name), apply,
+                                           "namespaces", name)
+        if meta.is_being_deleted(out) and not out["spec"]["finalizers"]:
+            return st.storage.delete(st.key_for("", name), "namespaces", name)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # discovery
+    # ------------------------------------------------------------------ #
+
+    def discovery_groups(self) -> Obj:
+        groups: Dict[str, List[str]] = {}
+        for info in self.scheme.resources():
+            if info.group:
+                groups.setdefault(info.group, [])
+                if info.version not in groups[info.group]:
+                    groups[info.group].append(info.version)
+        return {"kind": "APIGroupList", "apiVersion": "v1", "groups": [
+            {"name": g, "versions": [
+                {"groupVersion": f"{g}/{v}", "version": v} for v in vs],
+             "preferredVersion": {"groupVersion": f"{g}/{vs[0]}",
+                                  "version": vs[0]}}
+            for g, vs in sorted(groups.items())]}
+
+    def discovery_resources(self, group: str, version: str) -> Obj:
+        out = []
+        for info in self.scheme.resources():
+            if info.group == group and info.version == version:
+                out.append({"name": info.resource, "kind": info.kind,
+                            "namespaced": info.namespaced,
+                            "shortNames": list(info.short_names),
+                            "verbs": ["create", "delete", "deletecollection",
+                                      "get", "list", "patch", "update",
+                                      "watch"]})
+                for sub in info.subresources:
+                    out.append({"name": f"{info.resource}/{sub}",
+                                "kind": info.kind, "namespaced": info.namespaced,
+                                "verbs": ["get", "update", "patch"]})
+        return {"kind": "APIResourceList",
+                "groupVersion": f"{group}/{version}" if group else version,
+                "resources": out}
+
+
+# --------------------------------------------------------------------------- #
+# request model shared by HTTP gateway and in-process clients
+# --------------------------------------------------------------------------- #
+
+
+def handle_rest(api: APIServer, method: str, path: str,
+                query: Dict[str, str], body: Optional[Obj]):
+    """Route one REST request. Returns (code, obj) or ("WATCH", Watch)."""
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return 200, {"paths": ["/api", "/apis", "/healthz", "/metrics",
+                               "/version"]}
+
+    # non-resource endpoints
+    if parts[0] in ("healthz", "readyz", "livez"):
+        return 200, "ok"
+    if parts[0] == "version":
+        return 200, VERSION_INFO
+    if parts[0] == "api" and len(parts) == 1:
+        return 200, {"kind": "APIVersions", "versions": ["v1"]}
+    if parts[0] == "apis" and len(parts) == 1:
+        return 200, api.discovery_groups()
+    if parts[0] == "api" and len(parts) == 2:
+        return 200, api.discovery_resources("", parts[1])
+    if parts[0] == "apis" and len(parts) == 3:
+        return 200, api.discovery_resources(parts[1], parts[2])
+
+    # resource endpoints
+    if parts[0] == "api" and len(parts) >= 2:
+        group, rest = "", parts[2:]
+    elif parts[0] == "apis" and len(parts) >= 3:
+        group, rest = parts[1], parts[3:]
+    else:
+        raise errors.new_not_found("path", path)
+    if not rest:
+        raise errors.new_not_found("path", path)
+
+    # namespace scoping: namespaces/{ns}/{resource}/... — except the
+    # namespaces subresources themselves (namespaces/{name}/finalize|status),
+    # which the reference registers as explicit routes
+    namespace = ""
+    if rest[0] == "namespaces" and len(rest) >= 3 and not (
+            len(rest) == 3 and rest[2] in ("finalize", "status")):
+        namespace, rest = rest[1], rest[2:]
+    resource = rest[0]
+    name = rest[1] if len(rest) > 1 else ""
+    sub = rest[2] if len(rest) > 2 else ""
+
+    st = api.store(group, resource)
+    info = st.info
+
+    lsel = query.get("labelSelector", "")
+    fsel = query.get("fieldSelector", "")
+    rv = query.get("resourceVersion", "")
+    watching = query.get("watch", "") in ("true", "1")
+
+    if not name:
+        if watching:
+            return "WATCH", st.watch(namespace, lsel, fsel, rv)
+        if method == "GET":
+            return 200, st.list(namespace, lsel, fsel)
+        if method == "POST":
+            return 201, st.create(namespace, body or {})
+        if method == "DELETE":
+            gone = st.delete_collection(namespace, lsel, fsel)
+            return 200, api.scheme.new_list(info, gone)
+        raise errors.new_method_not_supported(resource, method)
+
+    # subresources
+    if sub:
+        if sub == "binding" and info.resource == "pods" and method == "POST":
+            return 201, api.bind_pod(namespace, name, body or {})
+        if sub == "eviction" and info.resource == "pods" and method == "POST":
+            return 201, api.evict_pod(namespace, name, body or {})
+        if sub == "scale":
+            if method == "GET":
+                return 200, api.get_scale(group, resource, namespace, name)
+            if method == "PUT":
+                return 200, api.put_scale(group, resource, namespace, name,
+                                          body or {})
+        if sub == "finalize" and info.resource == "namespaces" and method == "PUT":
+            return 200, api.finalize_namespace(name, body or {})
+        if sub == "status":
+            if method == "GET":
+                return 200, st.get(namespace, name)
+            if method == "PUT":
+                return 200, st.update(namespace, name, body or {},
+                                      subresource="status")
+            if method == "PATCH":
+                return 200, st.patch(namespace, name, body or {},
+                                     subresource="status")
+        raise errors.new_method_not_supported(f"{resource}/{sub}", method)
+
+    if watching:
+        return "WATCH", st.watch(namespace, lsel,
+                                 f"metadata.name={name}" + (f",{fsel}" if fsel else ""),
+                                 rv)
+    if method == "GET":
+        return 200, st.get(namespace, name)
+    if method == "PUT":
+        return 200, st.update(namespace, name, body or {})
+    if method == "PATCH":
+        return 200, st.patch(namespace, name, body or {})
+    if method == "DELETE":
+        if info.resource == "namespaces":
+            return 200, api.delete_namespace(name)
+        pre = (body or {}).get("preconditions", {}).get("resourceVersion")
+        return 200, st.delete(namespace, name, expected_rv=pre)
+    raise errors.new_method_not_supported(resource, method)
+
+
+# --------------------------------------------------------------------------- #
+# HTTP gateway
+# --------------------------------------------------------------------------- #
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kubernetes-tpu-apiserver"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _run(self, method: str) -> None:
+        api: APIServer = self.server.api  # type: ignore[attr-defined]
+        parsed = urlparse(self.path)
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        body: Optional[Obj] = None
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            try:
+                body = json.loads(self.rfile.read(length))
+            except json.JSONDecodeError:
+                self._reply(400, errors.new_bad_request("invalid JSON").status())
+                return
+        try:
+            result = handle_rest(api, method, parsed.path, query, body)
+        except errors.StatusError as e:
+            self._reply(e.code, e.status())
+            return
+        except Exception as e:  # noqa: BLE001 — the 500 boundary
+            self._reply(500, errors.StatusError(
+                500, "InternalError", str(e)).status())
+            return
+        if result[0] == "WATCH":
+            self._stream_watch(result[1], query)
+        else:
+            self._reply(result[0], result[1])
+
+    def _reply(self, code: int, obj: Any) -> None:
+        data = json.dumps(obj).encode() if not isinstance(obj, str) \
+            else obj.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _stream_watch(self, w: mwatch.Watch, query: Dict[str, str]) -> None:
+        """Chunked stream of {"type","object"} JSON lines — the watch wire
+        format (apimachinery streaming serializer)."""
+        timeout = float(query.get("timeoutSeconds", "3600"))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        try:
+            while _time.monotonic() < deadline:
+                ev = w.next(timeout=min(1.0, deadline - _time.monotonic()))
+                if ev is None:
+                    if w.stopped:
+                        break
+                    continue
+                line = json.dumps({"type": ev.type, "object": ev.object},
+                                  separators=(",", ":")) + "\n"
+                chunk = line.encode()
+                self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            w.stop()
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+
+    def do_GET(self):
+        self._run("GET")
+
+    def do_POST(self):
+        self._run("POST")
+
+    def do_PUT(self):
+        self._run("PUT")
+
+    def do_PATCH(self):
+        self._run("PATCH")
+
+    def do_DELETE(self):
+        self._run("DELETE")
+
+
+class _ThreadingHTTPServer(socketserver.ThreadingMixIn, socketserver.TCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class HTTPGateway:
+    """Serve an APIServer over HTTP (the kube-apiserver process boundary)."""
+
+    def __init__(self, api: APIServer, host: str = "127.0.0.1", port: int = 0):
+        self.api = api
+        self._httpd = _ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.api = api  # type: ignore[attr-defined]
+        self.host, self.port = self._httpd.server_address
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="apiserver-http", daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "HTTPGateway":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,314 @@
+"""Informers: reflector-fed shared caches with event handlers.
+
+Analog of client-go `tools/cache`: Reflector.ListAndWatch
+(`tools/cache/reflector.go:187`) → delta processing → thread-safe indexer
+store + handler fan-out (`shared_informer.go:293`). A 410 Gone (compacted
+watch) triggers relist, exactly as the reference reflector does; handlers see
+the same add/update/delete stream DeltaFIFO would deliver, including initial
+list synthesis.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.machinery import errors, meta
+from kubernetes_tpu.machinery import watch as mwatch
+from kubernetes_tpu.client.rest import ResourceClient
+
+Obj = Dict[str, Any]
+IndexFn = Callable[[Obj], List[str]]
+
+
+class Indexer:
+    """cache.ThreadSafeStore + Indexers: objects by key, plus named indexes
+    (e.g. pods by node name)."""
+
+    def __init__(self, index_fns: Optional[Dict[str, IndexFn]] = None):
+        self._mu = threading.RLock()
+        self._items: Dict[str, Obj] = {}
+        self._index_fns = dict(index_fns or {})
+        self._indexes: Dict[str, Dict[str, set]] = {
+            name: {} for name in self._index_fns}
+
+    def add_index(self, name: str, fn: IndexFn) -> None:
+        """cache.AddIndexers: register an index late and backfill it."""
+        with self._mu:
+            if name in self._index_fns:
+                return
+            self._index_fns[name] = fn
+            idx: Dict[str, set] = {}
+            for key, obj in self._items.items():
+                for v in fn(obj):
+                    idx.setdefault(v, set()).add(key)
+            self._indexes[name] = idx
+
+    def _update_index(self, key: str, old: Optional[Obj],
+                      new: Optional[Obj]) -> None:
+        for name, fn in self._index_fns.items():
+            idx = self._indexes[name]
+            if old is not None:
+                for v in fn(old):
+                    idx.get(v, set()).discard(key)
+            if new is not None:
+                for v in fn(new):
+                    idx.setdefault(v, set()).add(key)
+
+    def replace(self, objs: List[Obj]) -> None:
+        with self._mu:
+            self._items = {meta.namespaced_key(o): o for o in objs}
+            self._indexes = {name: {} for name in self._index_fns}
+            for k, o in self._items.items():
+                self._update_index(k, None, o)
+
+    def upsert(self, obj: Obj) -> Optional[Obj]:
+        key = meta.namespaced_key(obj)
+        with self._mu:
+            old = self._items.get(key)
+            self._items[key] = obj
+            self._update_index(key, old, obj)
+            return old
+
+    def delete(self, obj: Obj) -> Optional[Obj]:
+        key = meta.namespaced_key(obj)
+        with self._mu:
+            old = self._items.pop(key, None)
+            if old is not None:
+                self._update_index(key, old, None)
+            return old
+
+    def get(self, key: str) -> Optional[Obj]:
+        with self._mu:
+            return self._items.get(key)
+
+    def list(self) -> List[Obj]:
+        with self._mu:
+            return list(self._items.values())
+
+    def keys(self) -> List[str]:
+        with self._mu:
+            return list(self._items.keys())
+
+    def by_index(self, name: str, value: str) -> List[Obj]:
+        with self._mu:
+            keys = self._indexes.get(name, {}).get(value, set())
+            return [self._items[k] for k in keys if k in self._items]
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._items)
+
+
+class Lister:
+    """Namespace-aware read interface over an Indexer (client-go listers)."""
+
+    def __init__(self, indexer: Indexer):
+        self.indexer = indexer
+
+    def list(self, namespace: str = "",
+             selector: Optional[Callable[[Obj], bool]] = None) -> List[Obj]:
+        out = []
+        for o in self.indexer.list():
+            if namespace and meta.namespace(o) != namespace:
+                continue
+            if selector is not None and not selector(o):
+                continue
+            out.append(o)
+        return out
+
+    def get(self, namespace: str, name: str) -> Optional[Obj]:
+        key = f"{namespace}/{name}" if namespace else name
+        return self.indexer.get(key)
+
+
+class SharedInformer:
+    """One reflector + one indexer + N handlers for one resource."""
+
+    def __init__(self, rc: ResourceClient, namespace: str = "",
+                 label_selector: str = "", field_selector: str = "",
+                 index_fns: Optional[Dict[str, IndexFn]] = None,
+                 relist_backoff: float = 0.5):
+        self.rc = rc
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.field_selector = field_selector
+        self.indexer = Indexer(index_fns)
+        self.lister = Lister(self.indexer)
+        self.relist_backoff = relist_backoff
+        self._handlers: List[Tuple[Callable, Callable, Callable]] = []
+        self._handler_mu = threading.Lock()
+        self._stop = threading.Event()
+        self._synced = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watch: Optional[mwatch.Watch] = None
+        self.last_sync_rv = ""
+
+    # -- handler registration (AddEventHandler) ----------------------------- #
+
+    def add_handlers(self, on_add: Callable[[Obj], None] = lambda o: None,
+                     on_update: Callable[[Obj, Obj], None] = lambda o, n: None,
+                     on_delete: Callable[[Obj], None] = lambda o: None) -> None:
+        with self._handler_mu:
+            self._handlers.append((on_add, on_update, on_delete))
+            if self._synced.is_set():
+                # late joiner gets synthetic adds for current state
+                for o in self.indexer.list():
+                    on_add(o)
+
+    # -- lifecycle ---------------------------------------------------------- #
+
+    def start(self) -> "SharedInformer":
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"informer-{self.rc.resource}",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        w = self._watch
+        if w is not None:
+            w.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    @property
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    # -- the reflector loop (reflector.go:187 ListAndWatch) ----------------- #
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._list_and_watch()
+            except Exception:  # noqa: BLE001 — reflector retries everything
+                pass
+            if self._stop.wait(self.relist_backoff):
+                return
+
+    def _list_and_watch(self) -> None:
+        lst = self.rc.list(self.namespace, self.label_selector,
+                           self.field_selector)
+        items = lst.get("items", [])
+        rv = lst.get("metadata", {}).get("resourceVersion", "")
+        old_keys = set(self.indexer.keys())
+        # last-known objects become delete tombstones (DeltaFIFO
+        # DeletedFinalStateUnknown carries the final object, not just a key)
+        old_objs = {k: self.indexer.get(k) for k in old_keys}
+        self.indexer.replace(items)
+        self.last_sync_rv = rv
+        # synthesize deltas for the replace (DeltaFIFO Replace semantics)
+        new_keys = {meta.namespaced_key(o) for o in items}
+        with self._handler_mu:
+            handlers = list(self._handlers)
+        for o in items:
+            k = meta.namespaced_key(o)
+            for add, upd, _ in handlers:
+                if k in old_keys:
+                    upd(o, o)
+                else:
+                    add(o)
+        for k in old_keys - new_keys:
+            tomb = old_objs.get(k) or {"metadata": dict(zip(
+                ("namespace", "name"), meta.split_key(k)))}
+            for _, _, dele in handlers:
+                dele(tomb)
+        self._synced.set()
+
+        w = self.rc.watch(self.namespace, self.label_selector,
+                          self.field_selector, resource_version=rv)
+        self._watch = w
+        try:
+            while not self._stop.is_set():
+                ev = w.next(timeout=1.0)
+                if ev is None:
+                    if w.stopped:
+                        return  # stream ended → relist
+                    continue
+                if ev.type == mwatch.ERROR:
+                    # 410 Gone → relist from scratch (reflector.go relist)
+                    return
+                self._dispatch(ev)
+                self.last_sync_rv = meta.resource_version(ev.object) or \
+                    self.last_sync_rv
+        finally:
+            w.stop()
+            self._watch = None
+
+    def _dispatch(self, ev: mwatch.Event) -> None:
+        with self._handler_mu:
+            handlers = list(self._handlers)
+        if ev.type == mwatch.ADDED:
+            old = self.indexer.upsert(ev.object)
+            for add, upd, _ in handlers:
+                if old is None:
+                    add(ev.object)
+                else:
+                    upd(old, ev.object)
+        elif ev.type == mwatch.MODIFIED:
+            old = self.indexer.upsert(ev.object)
+            for add, upd, _ in handlers:
+                if old is None:
+                    add(ev.object)
+                else:
+                    upd(old, ev.object)
+        elif ev.type == mwatch.DELETED:
+            old = self.indexer.delete(ev.object)
+            for _, _, dele in handlers:
+                dele(old if old is not None else ev.object)
+
+
+class InformerFactory:
+    """SharedInformerFactory: one informer per resource, shared by consumers."""
+
+    def __init__(self, client):
+        self.client = client
+        self._informers: Dict[Tuple[str, str, str], SharedInformer] = {}
+        self._mu = threading.Lock()
+
+    def informer(self, attr: str, namespace: str = "",
+                 field_selector: str = "",
+                 index_fns: Optional[Dict[str, IndexFn]] = None) -> SharedInformer:
+        rc: ResourceClient = getattr(self.client, attr)
+        key = (rc.group, rc.resource, namespace, field_selector)
+        with self._mu:
+            inf = self._informers.get(key)
+            if inf is None:
+                inf = SharedInformer(
+                    rc, namespace=namespace, field_selector=field_selector,
+                    index_fns=index_fns)
+                self._informers[key] = inf
+            elif index_fns:
+                # a later consumer's indexes must still materialize on the
+                # shared informer (client-go AddIndexers)
+                for name, fn in index_fns.items():
+                    inf.indexer.add_index(name, fn)
+            return inf
+
+    def start(self) -> None:
+        with self._mu:
+            for inf in self._informers.values():
+                if inf._thread is None:
+                    inf.start()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        with self._mu:
+            infs = list(self._informers.values())
+        return all(i.wait_for_sync(timeout) for i in infs)
+
+    def stop(self) -> None:
+        with self._mu:
+            for inf in self._informers.values():
+                inf.stop()
+
+
+def pods_by_node_index(pod: Obj) -> List[str]:
+    """The pods-by-nodeName index every node-centric consumer wants."""
+    node = pod.get("spec", {}).get("nodeName", "")
+    return [node] if node else []

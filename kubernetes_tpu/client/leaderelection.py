@@ -1,0 +1,151 @@
+"""Lease-based leader election.
+
+Analog of client-go `tools/leaderelection/leaderelection.go:76` over
+coordination.k8s.io/v1 Leases: acquire by CAS-creating/claiming the Lease,
+renew on a timer, yield when renewal fails; callbacks mirror
+LeaderCallbacks{OnStartedLeading, OnStoppedLeading, OnNewLeader}.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kubernetes_tpu.machinery import errors, meta
+
+
+@dataclass
+class LeaderElectionConfig:
+    """tools/leaderelection.LeaderElectionConfig (+ the reference defaults,
+    apis/config/types.go LeaderElectionConfiguration: 15s/10s/2s)."""
+
+    lock_name: str
+    lock_namespace: str = "kube-system"
+    identity: str = ""
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+    on_started_leading: Callable[[], None] = lambda: None
+    on_stopped_leading: Callable[[], None] = lambda: None
+    on_new_leader: Callable[[str], None] = lambda ident: None
+
+
+class LeaderElector:
+    def __init__(self, client, config: LeaderElectionConfig):
+        self.client = client
+        self.cfg = config
+        if not self.cfg.identity:
+            import os
+            import uuid
+            self.cfg.identity = f"{os.uname().nodename}_{uuid.uuid4().hex[:8]}"
+        self._stop = threading.Event()
+        self._leading = threading.Event()
+        self._observed_leader = ""
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lease record ------------------------------------------------------- #
+
+    def _try_acquire_or_renew(self) -> bool:
+        leases = self.client.leases
+        now = time.time()
+        try:
+            lease = leases.get(self.cfg.lock_name, self.cfg.lock_namespace)
+        except errors.StatusError as e:
+            if not errors.is_not_found(e):
+                return False
+            try:
+                leases.create({
+                    "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                    "metadata": {"name": self.cfg.lock_name,
+                                 "namespace": self.cfg.lock_namespace},
+                    "spec": self._record(now)})
+                self._observe(self.cfg.identity)
+                return True
+            except errors.StatusError:
+                return False
+
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity", "")
+        renew = float(spec.get("renewTime", 0) or 0)
+        # expiry honors the HOLDER's advertised duration, not ours — a
+        # candidate with a shorter configured lease must not steal early
+        holder_duration = float(spec.get("leaseDurationSeconds",
+                                         self.cfg.lease_duration) or 0)
+        if (holder and holder != self.cfg.identity
+                and renew + holder_duration > now):
+            self._observe(holder)
+            return False  # someone else holds a live lease
+        # claim/renew via CAS on resourceVersion
+        lease["spec"] = self._record(
+            now, transitions=int(spec.get("leaseTransitions", 0))
+            + (0 if holder == self.cfg.identity else 1),
+            acquire=spec.get("acquireTime", now)
+            if holder == self.cfg.identity else now)
+        try:
+            leases.update(lease, self.cfg.lock_namespace)
+            self._observe(self.cfg.identity)
+            return True
+        except errors.StatusError:
+            return False
+
+    def _record(self, now: float, transitions: int = 0,
+                acquire: Optional[float] = None) -> dict:
+        return {"holderIdentity": self.cfg.identity,
+                "leaseDurationSeconds": int(self.cfg.lease_duration),
+                "acquireTime": acquire if acquire is not None else now,
+                "renewTime": now,
+                "leaseTransitions": transitions}
+
+    def _observe(self, leader: str) -> None:
+        if leader != self._observed_leader:
+            self._observed_leader = leader
+            self.cfg.on_new_leader(leader)
+
+    # -- run loop (leaderelection.go Run: acquire → renew → lost) ----------- #
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            # acquire phase
+            while not self._stop.is_set():
+                if self._try_acquire_or_renew():
+                    break
+                if self._stop.wait(self.cfg.retry_period):
+                    return
+            if self._stop.is_set():
+                return
+            self._leading.set()
+            self.cfg.on_started_leading()
+            # renew phase
+            deadline = time.monotonic() + self.cfg.renew_deadline
+            while not self._stop.is_set():
+                if self._try_acquire_or_renew():
+                    deadline = time.monotonic() + self.cfg.renew_deadline
+                elif time.monotonic() > deadline:
+                    break  # failed to renew in time → lost leadership
+                if self._stop.wait(self.cfg.retry_period):
+                    break
+            self._leading.clear()
+            self.cfg.on_stopped_leading()
+
+    def start(self) -> "LeaderElector":
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"leader-{self.cfg.lock_name}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+        if self._leading.is_set():
+            self._leading.clear()
+            self.cfg.on_stopped_leading()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    def wait_for_leadership(self, timeout: float = 10.0) -> bool:
+        return self._leading.wait(timeout)

@@ -1,0 +1,46 @@
+"""Controllers: desired-state convergence loops.
+
+TPU-native analog of SURVEY.md layer 6 (`pkg/controller`,
+`cmd/kube-controller-manager`).
+"""
+
+from kubernetes_tpu.controllers.base import (
+    Controller,
+    is_pod_active,
+    is_pod_ready,
+    pod_from_template,
+)
+from kubernetes_tpu.controllers.infra import (
+    DisruptionController,
+    EndpointsController,
+    GarbageCollector,
+    NamespaceController,
+    NodeLifecycleController,
+    PodGCController,
+    ResourceQuotaController,
+    TAINT_NOT_READY,
+    TAINT_UNREACHABLE,
+)
+from kubernetes_tpu.controllers.manager import (
+    ControllerManager,
+    DEFAULT_CONTROLLERS,
+)
+from kubernetes_tpu.controllers.workloads import (
+    CronJobController,
+    DaemonSetController,
+    DeploymentController,
+    JobController,
+    ReplicaSetController,
+    StatefulSetController,
+    pod_template_hash,
+)
+
+__all__ = [
+    "Controller", "ControllerManager", "CronJobController",
+    "DaemonSetController", "DEFAULT_CONTROLLERS", "DeploymentController",
+    "DisruptionController", "EndpointsController", "GarbageCollector",
+    "JobController", "NamespaceController", "NodeLifecycleController",
+    "PodGCController", "ReplicaSetController", "ResourceQuotaController",
+    "StatefulSetController", "TAINT_NOT_READY", "TAINT_UNREACHABLE",
+    "is_pod_active", "is_pod_ready", "pod_from_template", "pod_template_hash",
+]

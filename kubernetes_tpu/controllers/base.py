@@ -1,0 +1,147 @@
+"""The controller pattern: informer events → workqueue → sync loop.
+
+Analog of the shape every reference controller shares
+(`pkg/controller/replicaset/replica_set.go:139,470,610`): handlers enqueue
+namespaced keys, N workers pop keys and call `sync(key)`, failures requeue
+with rate-limited backoff, success forgets the key.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.client.informers import InformerFactory, SharedInformer
+from kubernetes_tpu.client.workqueue import RateLimitingQueue
+from kubernetes_tpu.machinery import meta
+
+
+class Controller:
+    """Base: wire informers to a keyed queue; run workers over sync(key)."""
+
+    name = "controller"
+    max_requeues = 15
+
+    def __init__(self, client, factory: InformerFactory, workers: int = 1):
+        self.client = client
+        self.factory = factory
+        self.queue = RateLimitingQueue()
+        self.workers = workers
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self.sync_errors: List[str] = []
+
+    # -- wiring helpers ----------------------------------------------------- #
+
+    def enqueue(self, obj: Dict) -> None:
+        self.queue.add(meta.namespaced_key(obj))
+
+    def enqueue_key(self, key: str) -> None:
+        self.queue.add(key)
+
+    def watch_resource(self, attr: str, enqueue_fn: Optional[Callable] = None,
+                       **informer_kw) -> SharedInformer:
+        inf = self.factory.informer(attr, **informer_kw)
+        fn = enqueue_fn or self.enqueue
+        inf.add_handlers(on_add=fn, on_update=lambda o, n: fn(n), on_delete=fn)
+        return inf
+
+    def watch_owned(self, attr: str, owner_kind: str) -> SharedInformer:
+        """Enqueue the controller owner of changed children
+        (resolveControllerRef, replica_set.go:319)."""
+
+        def enqueue_owner(obj: Dict) -> None:
+            ref = meta.controller_ref(obj)
+            if ref is not None and ref.get("kind") == owner_kind:
+                ns = meta.namespace(obj)
+                self.enqueue_key(f"{ns}/{ref['name']}" if ns else ref["name"])
+
+        inf = self.factory.informer(attr)
+        inf.add_handlers(on_add=enqueue_owner,
+                         on_update=lambda o, n: enqueue_owner(n),
+                         on_delete=enqueue_owner)
+        return inf
+
+    # -- lifecycle ---------------------------------------------------------- #
+
+    def sync(self, key: str) -> None:  # override
+        raise NotImplementedError
+
+    def _worker(self, stop: threading.Event, queue: RateLimitingQueue) -> None:
+        # stop/queue are captured per-generation so workers from a previous
+        # leadership term exit cleanly instead of serving the new queue
+        while not stop.is_set():
+            key = queue.get(timeout=0.5)
+            if key is None:
+                if queue.is_shutdown:
+                    return
+                continue
+            try:
+                self.sync(key)
+                queue.forget(key)
+            except Exception:  # noqa: BLE001 — controller loops never die
+                self.sync_errors.append(traceback.format_exc())
+                if queue.num_requeues(key) < self.max_requeues:
+                    queue.add_rate_limited(key)
+                else:
+                    queue.forget(key)
+            finally:
+                queue.done(key)
+
+    def start(self) -> "Controller":
+        """Start (or RE-start after stop — leadership can come back: the
+        manager's on_started_leading must be able to revive workers).
+        Handlers capture `self`, so swapping the queue re-arms them."""
+        if self._stop.is_set() or self.queue.is_shutdown:
+            self._stop = threading.Event()
+            self.queue = RateLimitingQueue()
+            self._threads = []
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker,
+                                 args=(self._stop, self.queue), daemon=True,
+                                 name=f"{self.name}-worker-{i}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=2)
+
+
+def pod_from_template(owner: Dict, template: Dict, name: str = "",
+                      generate_name: str = "") -> Dict:
+    """GetPodFromTemplate (pkg/controller/controller_utils.go): stamp labels,
+    ownerRef, and spec from the workload's pod template."""
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "namespace": meta.namespace(owner),
+            "labels": dict((template.get("metadata", {}).get("labels")) or {}),
+            "ownerReferences": [meta.owner_reference(owner)],
+        },
+        "spec": meta.deep_copy(template.get("spec", {})),
+    }
+    if name:
+        pod["metadata"]["name"] = name
+    else:
+        pod["metadata"]["generateName"] = generate_name or \
+            f"{meta.name(owner)}-"
+    return pod
+
+
+def is_pod_active(pod: Dict) -> bool:
+    """controller_utils.IsPodActive: not terminated, not being deleted."""
+    phase = pod.get("status", {}).get("phase", "")
+    return phase not in ("Succeeded", "Failed") and \
+        not meta.is_being_deleted(pod)
+
+
+def is_pod_ready(pod: Dict) -> bool:
+    for c in pod.get("status", {}).get("conditions", []) or []:
+        if c.get("type") == "Ready":
+            return c.get("status") == "True"
+    return False

@@ -135,9 +135,11 @@ class NativeKV:
         self._h = ctypes.c_void_p(lib.kv_new())
 
     def close(self) -> None:
-        if self._h:
-            self._lib.kv_free(self._h)
-            self._h = None
+        # Deliberately do NOT kv_free: daemon threads (informer reflectors,
+        # watch pumps) may still be inside a C call on this handle; freeing
+        # under them is a use-after-free. One store lives per process in
+        # production; tests leak a few KB per store instead of segfaulting.
+        self._h_closed = True
 
     def rev(self) -> int:
         return int(self._lib.kv_rev(self._h))

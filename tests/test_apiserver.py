@@ -1,0 +1,296 @@
+"""API server tests: registry semantics in-process + the HTTP boundary.
+
+Mirrors the reference's registry store tests + integration master tests
+(registry/generic/registry/store_test.go; test/integration/master).
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer, HTTPGateway, handle_rest
+from kubernetes_tpu.machinery import errors
+from kubernetes_tpu.machinery import watch as mwatch
+
+
+@pytest.fixture
+def api():
+    a = APIServer()
+    yield a
+    a.close()
+
+
+def mkpod(name, ns="default", node="", labels=None):
+    p = {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": name, "namespace": ns},
+         "spec": {"containers": [{"name": "c", "image": "img"}]}}
+    if labels:
+        p["metadata"]["labels"] = labels
+    if node:
+        p["spec"]["nodeName"] = node
+    return p
+
+
+class TestRegistry:
+    def test_create_defaults_and_validation(self, api):
+        pods = api.store("", "pods")
+        out = pods.create("default", mkpod("a"))
+        assert out["spec"]["schedulerName"] == "default-scheduler"
+        assert out["status"]["phase"] == "Pending"
+        assert out["metadata"]["uid"] and out["metadata"]["creationTimestamp"]
+        with pytest.raises(errors.StatusError) as ei:
+            pods.create("default", {"apiVersion": "v1", "kind": "Pod",
+                                    "metadata": {"name": "bad"}, "spec": {}})
+        assert ei.value.code == 422
+
+    def test_generate_name(self, api):
+        pods = api.store("", "pods")
+        p = mkpod("x")
+        del p["metadata"]["name"]
+        p["metadata"]["generateName"] = "web-"
+        out = pods.create("default", p)
+        assert out["metadata"]["name"].startswith("web-")
+
+    def test_namespace_mismatch_rejected(self, api):
+        with pytest.raises(errors.StatusError):
+            api.store("", "pods").create("other", mkpod("a", ns="default"))
+
+    def test_update_preserves_status_and_bumps_generation(self, api):
+        deploys = api.store("apps", "deployments")
+        d = {"apiVersion": "apps/v1", "kind": "Deployment",
+             "metadata": {"name": "web", "namespace": "default"},
+             "spec": {"replicas": 2,
+                      "selector": {"matchLabels": {"app": "web"}},
+                      "template": {"metadata": {"labels": {"app": "web"}},
+                                   "spec": {"containers": [{"name": "c"}]}}}}
+        created = deploys.create("default", d)
+        assert created["metadata"]["generation"] == 1
+        # controller writes status
+        created["status"] = {"replicas": 2, "readyReplicas": 2}
+        st = deploys.update("default", "web", created, subresource="status")
+        assert st["status"]["readyReplicas"] == 2
+        assert st["metadata"]["generation"] == 1  # status doesn't bump
+        # user scales spec
+        st["spec"]["replicas"] = 5
+        up = deploys.update("default", "web", st)
+        assert up["metadata"]["generation"] == 2
+        assert up["status"]["readyReplicas"] == 2  # spec update keeps status
+
+    def test_update_rv_conflict(self, api):
+        pods = api.store("", "pods")
+        a = pods.create("default", mkpod("a"))
+        stale = dict(a)
+        pods.update("default", "a", a)  # bumps rv
+        with pytest.raises(errors.StatusError) as ei:
+            pods.update("default", "a", stale)
+        assert errors.is_conflict(ei.value)
+
+    def test_patch_merge(self, api):
+        pods = api.store("", "pods")
+        pods.create("default", mkpod("a", labels={"x": "1"}))
+        out = pods.patch("default", "a",
+                         {"metadata": {"labels": {"y": "2"}},
+                          "spec": {"priority": 10}})
+        assert out["metadata"]["labels"] == {"x": "1", "y": "2"}
+        assert out["spec"]["priority"] == 10
+        # None deletes a key (RFC 7386)
+        out = pods.patch("default", "a", {"metadata": {"labels": {"x": None}}})
+        assert out["metadata"]["labels"] == {"y": "2"}
+
+    def test_list_selectors(self, api):
+        pods = api.store("", "pods")
+        pods.create("default", mkpod("a", labels={"app": "web"}, node="n1"))
+        pods.create("default", mkpod("b", labels={"app": "web"}))
+        pods.create("default", mkpod("c", labels={"app": "db"}))
+        assert len(pods.list("default")["items"]) == 3
+        assert len(pods.list("default", label_selector="app=web")["items"]) == 2
+        got = pods.list("default", field_selector="spec.nodeName=n1")["items"]
+        assert [p["metadata"]["name"] for p in got] == ["a"]
+        unsched = pods.list("default", field_selector="spec.nodeName=")["items"]
+        assert {p["metadata"]["name"] for p in unsched} == {"b", "c"}
+
+    def test_finalizer_two_phase_delete(self, api):
+        cms = api.store("", "configmaps")
+        cms.create("default", {"apiVersion": "v1", "kind": "ConfigMap",
+                               "metadata": {"name": "cm",
+                                            "finalizers": ["example/protect"]}})
+        out = cms.delete("default", "cm")
+        assert out["metadata"]["deletionTimestamp"]
+        assert cms.get("default", "cm")  # still there
+        # removing the finalizer completes the delete
+        got = cms.get("default", "cm")
+        got["metadata"]["finalizers"] = []
+        cms.update("default", "cm", got)
+        with pytest.raises(errors.StatusError):
+            cms.get("default", "cm")
+
+    def test_watch_with_selector(self, api):
+        pods = api.store("", "pods")
+        w = pods.watch("default", label_selector="app=web")
+        pods.create("default", mkpod("a", labels={"app": "web"}))
+        pods.create("default", mkpod("b", labels={"app": "db"}))
+        ev = w.next(timeout=2)
+        assert ev.type == mwatch.ADDED and ev.object["metadata"]["name"] == "a"
+        w.stop()
+
+
+class TestSubresources:
+    def test_binding_flow(self, api):
+        pods = api.store("", "pods")
+        pods.create("default", mkpod("a"))
+        out = api.bind_pod("default", "a", {"target": {"name": "n1"}})
+        assert out["spec"]["nodeName"] == "n1"
+        assert any(c["type"] == "PodScheduled"
+                   for c in out["status"]["conditions"])
+        with pytest.raises(errors.StatusError) as ei:
+            api.bind_pod("default", "a", {"target": {"name": "n2"}})
+        assert errors.is_conflict(ei.value)
+
+    def test_scale(self, api):
+        deploys = api.store("apps", "deployments")
+        deploys.create("default", {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 1, "selector": {"matchLabels": {"a": "b"}},
+                     "template": {"metadata": {"labels": {"a": "b"}},
+                                  "spec": {"containers": [{"name": "c"}]}}}})
+        sc = api.get_scale("apps", "deployments", "default", "web")
+        assert sc["spec"]["replicas"] == 1 and sc["kind"] == "Scale"
+        api.put_scale("apps", "deployments", "default", "web",
+                      {"spec": {"replicas": 4}})
+        assert deploys.get("default", "web")["spec"]["replicas"] == 4
+
+    def test_namespace_lifecycle(self, api):
+        nss = api.store("", "namespaces")
+        nss.create("", {"apiVersion": "v1", "kind": "Namespace",
+                        "metadata": {"name": "team-a"}})
+        got = nss.get("", "team-a")
+        assert got["spec"]["finalizers"] == ["kubernetes"]
+        assert got["status"]["phase"] == "Active"
+        out = api.delete_namespace("team-a")
+        assert out["status"]["phase"] == "Terminating"
+        # namespace controller clears content then finalizes
+        out["spec"]["finalizers"] = []
+        api.finalize_namespace("team-a", out)
+        with pytest.raises(errors.StatusError):
+            nss.get("", "team-a")
+
+
+class TestHTTP:
+    @pytest.fixture
+    def gw(self, api):
+        g = HTTPGateway(api).start()
+        yield g
+        g.stop()
+
+    def _req(self, gw, method, path, body=None):
+        req = urllib.request.Request(gw.url + path, method=method)
+        data = json.dumps(body).encode() if body is not None else None
+        if data:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, data=data, timeout=5) as r:
+                raw = r.read()
+                try:
+                    return r.status, json.loads(raw)
+                except json.JSONDecodeError:
+                    return r.status, raw.decode()
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_crud_over_http(self, gw):
+        code, _ = self._req(gw, "GET", "/healthz")
+        assert code == 200
+        code, created = self._req(gw, "POST", "/api/v1/namespaces/default/pods",
+                                  mkpod("h1"))
+        assert code == 201
+        code, got = self._req(gw, "GET", "/api/v1/namespaces/default/pods/h1")
+        assert code == 200 and got["metadata"]["name"] == "h1"
+        code, lst = self._req(gw, "GET", "/api/v1/pods")
+        assert code == 200 and lst["kind"] == "PodList" and len(lst["items"]) == 1
+        code, st = self._req(gw, "GET", "/api/v1/namespaces/default/pods/nope")
+        assert code == 404 and st["reason"] == "NotFound"
+        code, _ = self._req(gw, "DELETE", "/api/v1/namespaces/default/pods/h1")
+        assert code == 200
+
+    def test_apps_group_and_discovery(self, gw):
+        code, vers = self._req(gw, "GET", "/api")
+        assert code == 200 and vers["versions"] == ["v1"]
+        code, groups = self._req(gw, "GET", "/apis")
+        names = [g["name"] for g in groups["groups"]]
+        assert "apps" in names and "batch" in names
+        code, rl = self._req(gw, "GET", "/apis/apps/v1")
+        assert any(r["name"] == "deployments" for r in rl["resources"])
+        d = {"apiVersion": "apps/v1", "kind": "Deployment",
+             "metadata": {"name": "web"},
+             "spec": {"selector": {"matchLabels": {"a": "b"}},
+                      "template": {"metadata": {"labels": {"a": "b"}},
+                                   "spec": {"containers": [{"name": "c"}]}}}}
+        code, out = self._req(gw, "POST",
+                              "/apis/apps/v1/namespaces/default/deployments", d)
+        assert code == 201 and out["spec"]["replicas"] == 1  # defaulted
+
+    def test_binding_over_http(self, gw):
+        self._req(gw, "POST", "/api/v1/namespaces/default/pods", mkpod("b1"))
+        code, out = self._req(
+            gw, "POST", "/api/v1/namespaces/default/pods/b1/binding",
+            {"apiVersion": "v1", "kind": "Binding",
+             "metadata": {"name": "b1"}, "target": {"name": "node-9"}})
+        assert code == 201 and out["spec"]["nodeName"] == "node-9"
+
+    def test_watch_stream_over_http(self, gw):
+        events = []
+        done = threading.Event()
+
+        def watch():
+            req = urllib.request.Request(
+                gw.url + "/api/v1/namespaces/default/pods?watch=true&timeoutSeconds=10")
+            with urllib.request.urlopen(req, timeout=15) as r:
+                for raw in r:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    events.append(json.loads(line))
+                    if len(events) >= 2:
+                        break
+            done.set()
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        import time
+        time.sleep(0.3)  # let the watch register
+        self._req(gw, "POST", "/api/v1/namespaces/default/pods", mkpod("w1"))
+        self._req(gw, "DELETE", "/api/v1/namespaces/default/pods/w1")
+        assert done.wait(timeout=10)
+        assert [e["type"] for e in events] == ["ADDED", "DELETED"]
+        assert events[0]["object"]["metadata"]["name"] == "w1"
+
+    def test_field_selector_over_http(self, gw):
+        self._req(gw, "POST", "/api/v1/namespaces/default/pods", mkpod("f1", node="n1"))
+        self._req(gw, "POST", "/api/v1/namespaces/default/pods", mkpod("f2"))
+        code, lst = self._req(
+            gw, "GET", "/api/v1/pods?fieldSelector=spec.nodeName%3D")
+        assert code == 200
+        assert [p["metadata"]["name"] for p in lst["items"]] == ["f2"]
+
+
+class TestUpdateValidation:
+    def test_put_cannot_store_invalid_object(self, api):
+        """Regression: PUT/PATCH must run validation even when the admission
+        chain returns the object unchanged."""
+        deploys = api.store("apps", "deployments")
+        d = deploys.create("default", {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "v", "namespace": "default"},
+            "spec": {"selector": {"matchLabels": {"a": "b"}},
+                     "template": {"metadata": {"labels": {"a": "b"}},
+                                  "spec": {"containers": [{"name": "c"}]}}}})
+        bad = dict(d)
+        bad["spec"] = {"replicas": 1, "template": d["spec"]["template"]}
+        with pytest.raises(errors.StatusError) as ei:
+            deploys.update("default", "v", bad)
+        assert ei.value.code == 422
+        with pytest.raises(errors.StatusError):
+            deploys.patch("default", "v", {"spec": {"selector": None}})

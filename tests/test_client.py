@@ -1,0 +1,304 @@
+"""Client machinery: clientset verbs, informers, workqueue, leader election.
+
+Mirrors client-go's tools/cache + util/workqueue + tools/leaderelection test
+coverage, run against a real in-process apiserver (both transports).
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer, HTTPGateway
+from kubernetes_tpu.client import (
+    Client,
+    EventRecorder,
+    InformerFactory,
+    LeaderElectionConfig,
+    LeaderElector,
+    RateLimitingQueue,
+    SharedInformer,
+    WorkQueue,
+    pods_by_node_index,
+)
+from kubernetes_tpu.machinery import errors
+
+
+@pytest.fixture
+def api():
+    a = APIServer()
+    yield a
+    a.close()
+
+
+@pytest.fixture(params=["local", "http"])
+def client(request, api):
+    if request.param == "local":
+        yield Client.local(api)
+    else:
+        gw = HTTPGateway(api).start()
+        yield Client.http(gw.url)
+        gw.stop()
+
+
+def mkpod(name, ns="default", node="", labels=None):
+    p = {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": name, "namespace": ns},
+         "spec": {"containers": [{"name": "c", "image": "img"}]}}
+    if labels:
+        p["metadata"]["labels"] = labels
+    if node:
+        p["spec"]["nodeName"] = node
+    return p
+
+
+class TestClientVerbs:
+    def test_crud_and_bind(self, client):
+        client.pods.create(mkpod("a"))
+        got = client.pods.get("a")
+        assert got["metadata"]["name"] == "a"
+        client.pods.bind("a", "n1", uid=got["metadata"]["uid"])
+        assert client.pods.get("a")["spec"]["nodeName"] == "n1"
+        lst = client.pods.list(field_selector="spec.nodeName=n1")
+        assert len(lst["items"]) == 1
+        client.pods.delete("a")
+        with pytest.raises(errors.StatusError):
+            client.pods.get("a")
+
+    def test_status_and_patch(self, client):
+        client.nodes.create({"apiVersion": "v1", "kind": "Node",
+                             "metadata": {"name": "n1"},
+                             "status": {"capacity": {"cpu": "4"}}})
+        client.nodes.patch_status("n1", {"status": {"phase": "Running"}},
+                                  namespace="")
+        got = client.nodes.get("n1", namespace="")
+        assert got["status"]["phase"] == "Running"
+        assert got["status"]["capacity"]["cpu"] == "4"
+
+    def test_watch_via_client(self, client):
+        w = client.pods.watch(namespace="default")
+        time.sleep(0.2)
+        client.pods.create(mkpod("w1"))
+        ev = w.next(timeout=5)
+        assert ev is not None and ev.type == "ADDED"
+        assert ev.object["metadata"]["name"] == "w1"
+        w.stop()
+
+
+class TestInformer:
+    def test_sync_dispatch_and_index(self, api):
+        client = Client.local(api)
+        client.pods.create(mkpod("pre", node="n1"))
+        adds, updates, deletes = [], [], []
+        inf = SharedInformer(client.pods,
+                             index_fns={"byNode": pods_by_node_index})
+        inf.add_handlers(
+            on_add=lambda o: adds.append(o["metadata"]["name"]),
+            on_update=lambda o, n: updates.append(n["metadata"]["name"]),
+            on_delete=lambda o: deletes.append(o["metadata"]["name"]))
+        inf.start()
+        assert inf.wait_for_sync()
+        assert adds == ["pre"]
+        client.pods.create(mkpod("live", node="n1"))
+        time.sleep(0.5)
+        assert "live" in adds
+        assert [p["metadata"]["name"] for p in
+                inf.indexer.by_index("byNode", "n1")] == ["pre", "live"] or \
+               sorted(p["metadata"]["name"] for p in
+                      inf.indexer.by_index("byNode", "n1")) == ["live", "pre"]
+        got = client.pods.get("live")
+        got["metadata"]["labels"] = {"x": "1"}
+        client.pods.update(got)
+        time.sleep(0.5)
+        assert "live" in updates
+        client.pods.delete("pre")
+        time.sleep(0.5)
+        assert deletes == ["pre"]
+        assert inf.lister.get("default", "pre") is None
+        inf.stop()
+
+    def test_relist_after_stream_end(self, api):
+        client = Client.local(api)
+        inf = SharedInformer(client.pods, relist_backoff=0.1)
+        inf.start()
+        assert inf.wait_for_sync()
+        # kill the live watch; the reflector must relist and keep going
+        inf._watch.stop()
+        time.sleep(0.5)
+        client.pods.create(mkpod("after-relist"))
+        time.sleep(0.8)
+        assert inf.lister.get("default", "after-relist") is not None
+        inf.stop()
+
+    def test_factory_shares_informers(self, api):
+        client = Client.local(api)
+        f = InformerFactory(client)
+        a = f.informer("pods")
+        b = f.informer("pods")
+        assert a is b
+        f.start()
+        assert f.wait_for_sync()
+        f.stop()
+
+
+class TestWorkQueue:
+    def test_dedup_and_done_requeue(self):
+        q = WorkQueue()
+        q.add("a")
+        q.add("a")  # dedup while queued
+        assert len(q) == 1
+        item = q.get(timeout=1)
+        assert item == "a"
+        q.add("a")  # re-added while processing → dirty
+        assert len(q) == 0
+        q.done("a")  # returns to queue
+        assert q.get(timeout=1) == "a"
+        q.done("a")
+        q.shutdown()
+        assert q.get(timeout=0.1) is None
+
+    def test_rate_limited_backoff_grows(self):
+        q = RateLimitingQueue()
+        t0 = time.monotonic()
+        q.add_rate_limited("x")  # 5ms
+        assert q.get(timeout=2) == "x"
+        q.done("x")
+        assert q.num_requeues("x") == 1
+        q.forget("x")
+        assert q.num_requeues("x") == 0
+        q.shutdown()
+
+    def test_add_after_delays(self):
+        q = RateLimitingQueue()
+        q.add_after("slow", 0.3)
+        t0 = time.monotonic()
+        assert q.get(timeout=3) == "slow"
+        assert time.monotonic() - t0 >= 0.2
+        q.shutdown()
+
+
+class TestLeaderElection:
+    def test_single_leader_and_failover(self, api):
+        client = Client.local(api)
+        events = []
+
+        def mk(ident):
+            return LeaderElector(client, LeaderElectionConfig(
+                lock_name="sched", identity=ident,
+                lease_duration=0.8, renew_deadline=0.5, retry_period=0.1,
+                on_started_leading=lambda: events.append(("up", ident)),
+                on_stopped_leading=lambda: events.append(("down", ident))))
+
+        a, b = mk("a"), mk("b")
+        a.start()
+        assert a.wait_for_leadership(5)
+        b.start()
+        time.sleep(0.5)
+        assert not b.is_leader  # live lease blocks b
+        a.stop()  # a stops renewing; b must take over after expiry
+        assert b.wait_for_leadership(5)
+        assert ("up", "a") in events and ("up", "b") in events
+        b.stop()
+
+
+class TestEvents:
+    def test_record_and_aggregate(self, api):
+        client = Client.local(api)
+        rec = EventRecorder(client, component="scheduler")
+        pod = client.pods.create(mkpod("evt"))
+        rec.event(pod, "Warning", "FailedScheduling", "0/3 nodes available")
+        rec.event(pod, "Warning", "FailedScheduling", "0/3 nodes available")
+        evs = client.events.list("default")["items"]
+        assert len(evs) == 1
+        assert evs[0]["count"] == 2
+        assert evs[0]["reason"] == "FailedScheduling"
+        assert evs[0]["source"]["component"] == "scheduler"
+
+
+class TestInformerFactoryKeys:
+    def test_namespace_scoped_informers_not_conflated(self, api):
+        client = Client.local(api)
+        client.pods.create(mkpod("in-default"))
+        f = InformerFactory(client)
+        scoped = f.informer("pods", namespace="kube-system")
+        unscoped = f.informer("pods")
+        assert scoped is not unscoped
+        f.start()
+        assert f.wait_for_sync()
+        assert unscoped.lister.get("default", "in-default") is not None
+        assert scoped.lister.get("default", "in-default") is None
+        f.stop()
+
+    def test_late_index_fns_backfilled(self, api):
+        client = Client.local(api)
+        client.pods.create(mkpod("idx", node="n9"))
+        f = InformerFactory(client)
+        f.informer("pods")
+        f.start()
+        assert f.wait_for_sync()
+        inf = f.informer("pods", index_fns={"byNode": pods_by_node_index})
+        got = inf.indexer.by_index("byNode", "n9")
+        assert [p["metadata"]["name"] for p in got] == ["idx"]
+        f.stop()
+
+
+class TestRelistTombstones:
+    def test_delete_during_relist_carries_last_known_object(self, api):
+        client = Client.local(api)
+        client.pods.create(mkpod("t1", labels={"app": "x"}))
+        inf = SharedInformer(client.pods, relist_backoff=0.1)
+        deletes = []
+        inf.add_handlers(on_delete=lambda o: deletes.append(o))
+        inf.start()
+        assert inf.wait_for_sync()
+        # kill the watch, delete while the informer is blind, let it relist
+        inf._watch.stop()
+        client.pods.delete("t1")
+        time.sleep(1.0)
+        assert deletes, "relist did not synthesize the delete"
+        assert deletes[-1].get("metadata", {}).get("labels") == {"app": "x"}
+        inf.stop()
+
+
+class TestControllerRestart:
+    def test_controller_revives_after_stop(self, api):
+        from kubernetes_tpu.controllers import ReplicaSetController
+        from kubernetes_tpu.client import InformerFactory
+        client = Client.local(api)
+        f = InformerFactory(client)
+        c = ReplicaSetController(client, f)
+        f.start()
+        f.wait_for_sync()
+        c.start()
+        c.stop()
+        c.start()  # leadership regained: workers must serve again
+        rs = {"apiVersion": "apps/v1", "kind": "ReplicaSet",
+              "metadata": {"name": "revive", "namespace": "default"},
+              "spec": {"replicas": 1,
+                       "selector": {"matchLabels": {"app": "revive"}},
+                       "template": {"metadata": {"labels": {"app": "revive"}},
+                                    "spec": {"containers": [{"name": "c"}]}}}}
+        client.replicasets.create(rs)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if len(client.pods.list("default",
+                                    label_selector="app=revive")["items"]) == 1:
+                break
+            time.sleep(0.1)
+        assert len(client.pods.list("default",
+                                    label_selector="app=revive")["items"]) == 1
+        c.stop()
+        f.stop()
+
+
+class TestEventRecreate:
+    def test_event_recreated_after_server_side_delete(self, api):
+        client = Client.local(api)
+        rec = EventRecorder(client)
+        pod = client.pods.create(mkpod("edel"))
+        rec.event(pod, "Warning", "X", "msg")
+        name = client.events.list("default")["items"][0]["metadata"]["name"]
+        client.events.delete(name, "default")
+        rec.event(pod, "Warning", "X", "msg")
+        evs = client.events.list("default")["items"]
+        assert len(evs) == 1 and evs[0]["count"] == 1

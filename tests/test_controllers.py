@@ -1,0 +1,402 @@
+"""Controller convergence tests against a real in-process apiserver.
+
+The shape of pkg/controller/*/…_test.go: create the workload object, run the
+controller, assert the child objects and status converge. A helper fakes the
+kubelet by marking pods Running/Ready.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Client, InformerFactory
+from kubernetes_tpu.controllers import (
+    ControllerManager,
+    NodeLifecycleController,
+    TAINT_UNREACHABLE,
+)
+from kubernetes_tpu.machinery import errors, meta
+
+
+@pytest.fixture
+def api():
+    a = APIServer()
+    yield a
+    a.close()
+
+
+@pytest.fixture
+def client(api):
+    return Client.local(api)
+
+
+def wait_for(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def mark_pods_running(client, ns="default", selector=""):
+    """Fake-kubelet helper: set phase Running + Ready condition + podIP."""
+    n = 0
+    for pod in client.pods.list(ns, label_selector=selector)["items"]:
+        if pod.get("status", {}).get("phase") == "Running":
+            continue
+        pod["status"] = {"phase": "Running", "podIP": f"10.0.0.{n + 1}",
+                         "conditions": [{"type": "Ready", "status": "True"}]}
+        client.pods.update_status(pod, ns)
+        n += 1
+    return n
+
+
+def deployment(name="web", replicas=3, image="img:v1"):
+    return {"apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"replicas": replicas,
+                     "selector": {"matchLabels": {"app": name}},
+                     "template": {
+                         "metadata": {"labels": {"app": name}},
+                         "spec": {"containers": [
+                             {"name": "c", "image": image}]}}}}
+
+
+@pytest.fixture
+def cm(client):
+    m = ControllerManager(client, poll_interval=0.2).start()
+    yield m
+    m.stop()
+
+
+class TestReplicaSet:
+    def test_scale_up_and_down(self, client, cm):
+        rs = {"apiVersion": "apps/v1", "kind": "ReplicaSet",
+              "metadata": {"name": "rs1", "namespace": "default"},
+              "spec": {"replicas": 3,
+                       "selector": {"matchLabels": {"app": "rs1"}},
+                       "template": {"metadata": {"labels": {"app": "rs1"}},
+                                    "spec": {"containers": [{"name": "c"}]}}}}
+        client.replicasets.create(rs)
+        assert wait_for(lambda: len(client.pods.list(
+            "default", label_selector="app=rs1")["items"]) == 3)
+        # status converges
+        assert wait_for(lambda: client.replicasets.get("rs1")
+                        .get("status", {}).get("replicas") == 3)
+        # scale down via the scale subresource
+        client.replicasets.put_scale("rs1", 1)
+        assert wait_for(lambda: len([
+            p for p in client.pods.list(
+                "default", label_selector="app=rs1")["items"]]) == 1)
+
+    def test_pod_deletion_replaced(self, client, cm):
+        rs = {"apiVersion": "apps/v1", "kind": "ReplicaSet",
+              "metadata": {"name": "rs2", "namespace": "default"},
+              "spec": {"replicas": 2,
+                       "selector": {"matchLabels": {"app": "rs2"}},
+                       "template": {"metadata": {"labels": {"app": "rs2"}},
+                                    "spec": {"containers": [{"name": "c"}]}}}}
+        client.replicasets.create(rs)
+        assert wait_for(lambda: len(client.pods.list(
+            "default", label_selector="app=rs2")["items"]) == 2)
+        victim = client.pods.list("default", label_selector="app=rs2")["items"][0]
+        client.pods.delete(meta.name(victim))
+        assert wait_for(lambda: len(client.pods.list(
+            "default", label_selector="app=rs2")["items"]) == 2)
+
+
+class TestDeployment:
+    def test_creates_replicaset_and_pods(self, client, cm):
+        client.deployments.create(deployment("web", replicas=2))
+        assert wait_for(lambda: len(client.replicasets.list(
+            "default")["items"]) == 1)
+        rs = client.replicasets.list("default")["items"][0]
+        assert (meta.controller_ref(rs) or {}).get("kind") == "Deployment"
+        assert wait_for(lambda: len(client.pods.list(
+            "default", label_selector="app=web")["items"]) == 2)
+
+    def test_rolling_update_to_new_template(self, client, cm):
+        client.deployments.create(deployment("roll", replicas=2, image="img:v1"))
+        assert wait_for(lambda: len(client.pods.list(
+            "default", label_selector="app=roll")["items"]) == 2)
+        mark_pods_running(client, selector="app=roll")
+        # new template → new RS; old scales away as new pods turn Ready
+        d = client.deployments.get("roll")
+        d["spec"]["template"]["spec"]["containers"][0]["image"] = "img:v2"
+        client.deployments.update(d)
+
+        def converged():
+            mark_pods_running(client, selector="app=roll")
+            rses = client.replicasets.list("default")["items"]
+            rses = [r for r in rses
+                    if (meta.controller_ref(r) or {}).get("kind") == "Deployment"
+                    and r["metadata"]["name"].startswith("roll-")]
+            if len(rses) != 2:
+                return False
+            new = [r for r in rses if any(
+                c.get("image") == "img:v2"
+                for c in r["spec"]["template"]["spec"]["containers"])]
+            old = [r for r in rses if r not in new]
+            return (new and int(new[0]["spec"]["replicas"]) == 2
+                    and old and int(old[0]["spec"]["replicas"]) == 0)
+
+        assert wait_for(converged, timeout=15)
+
+
+class TestJob:
+    def test_job_runs_to_completion(self, client, cm):
+        job = {"apiVersion": "batch/v1", "kind": "Job",
+               "metadata": {"name": "sum", "namespace": "default"},
+               "spec": {"completions": 2, "parallelism": 2,
+                        "template": {"metadata": {"labels": {"job": "sum"}},
+                                     "spec": {"containers": [{"name": "c"}],
+                                              "restartPolicy": "Never"}}}}
+        client.jobs.create(job)
+        assert wait_for(lambda: len(client.pods.list(
+            "default", label_selector="job=sum")["items"]) == 2)
+        # fake kubelet: pods succeed
+        for p in client.pods.list("default", label_selector="job=sum")["items"]:
+            p["status"] = {"phase": "Succeeded"}
+            client.pods.update_status(p)
+        assert wait_for(lambda: any(
+            c.get("type") == "Complete" and c.get("status") == "True"
+            for c in client.jobs.get("sum").get("status", {})
+            .get("conditions", [])))
+
+    def test_backoff_limit_fails_job(self, client, cm):
+        job = {"apiVersion": "batch/v1", "kind": "Job",
+               "metadata": {"name": "boom", "namespace": "default"},
+               "spec": {"completions": 1, "parallelism": 1, "backoffLimit": 0,
+                        "template": {"metadata": {"labels": {"job": "boom"}},
+                                     "spec": {"containers": [{"name": "c"}],
+                                              "restartPolicy": "Never"}}}}
+        client.jobs.create(job)
+        assert wait_for(lambda: len(client.pods.list(
+            "default", label_selector="job=boom")["items"]) >= 1)
+        for p in client.pods.list("default", label_selector="job=boom")["items"]:
+            p["status"] = {"phase": "Failed"}
+            client.pods.update_status(p)
+        assert wait_for(lambda: any(
+            c.get("type") == "Failed" and c.get("status") == "True"
+            for c in client.jobs.get("boom").get("status", {})
+            .get("conditions", [])))
+
+
+class TestStatefulSet:
+    def test_ordered_stable_identity(self, client, cm):
+        ss = {"apiVersion": "apps/v1", "kind": "StatefulSet",
+              "metadata": {"name": "db", "namespace": "default"},
+              "spec": {"replicas": 3, "serviceName": "db",
+                       "selector": {"matchLabels": {"app": "db"}},
+                       "template": {"metadata": {"labels": {"app": "db"}},
+                                    "spec": {"containers": [{"name": "c"}]}}}}
+        client.statefulsets.create(ss)
+        # OrderedReady: db-0 first, db-1 only after db-0 Ready
+        assert wait_for(lambda: client.pods.list(
+            "default", label_selector="app=db")["items"] and
+            client.pods.list("default", label_selector="app=db")["items"][0]
+            ["metadata"]["name"] == "db-0")
+        time.sleep(0.4)
+        assert len(client.pods.list("default",
+                                    label_selector="app=db")["items"]) == 1
+
+        def advance():
+            mark_pods_running(client, selector="app=db")
+            names = sorted(p["metadata"]["name"] for p in client.pods.list(
+                "default", label_selector="app=db")["items"])
+            return names == ["db-0", "db-1", "db-2"]
+
+        assert wait_for(advance, timeout=15)
+
+
+class TestDaemonSet:
+    def test_one_pod_per_eligible_node(self, client, cm):
+        for n in ("n1", "n2"):
+            client.nodes.create({"apiVersion": "v1", "kind": "Node",
+                                 "metadata": {"name": n}})
+        client.nodes.create({"apiVersion": "v1", "kind": "Node",
+                             "metadata": {"name": "cordoned"},
+                             "spec": {"unschedulable": True}})
+        ds = {"apiVersion": "apps/v1", "kind": "DaemonSet",
+              "metadata": {"name": "agent", "namespace": "default"},
+              "spec": {"selector": {"matchLabels": {"app": "agent"}},
+                       "template": {"metadata": {"labels": {"app": "agent"}},
+                                    "spec": {"containers": [{"name": "c"}]}}}}
+        client.daemonsets.create(ds)
+
+        def placed():
+            pods = client.pods.list("default",
+                                    label_selector="app=agent")["items"]
+            nodes = sorted(p["spec"].get("nodeName", "") for p in pods)
+            return nodes == ["n1", "n2"]
+
+        assert wait_for(placed)
+
+
+class TestEndpointsAndServices:
+    def test_endpoints_track_ready_pods(self, client, cm):
+        client.services.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"selector": {"app": "web"},
+                     "ports": [{"port": 80, "targetPort": 8080}]}})
+        client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "w1", "namespace": "default",
+                         "labels": {"app": "web"}},
+            "spec": {"containers": [{"name": "c"}], "nodeName": "n1"}})
+        mark_pods_running(client, selector="app=web")
+        assert wait_for(lambda: (client.endpoints.get("web")
+                                 .get("subsets") or [{}])[0].get("addresses"))
+        ep = client.endpoints.get("web")
+        assert ep["subsets"][0]["addresses"][0]["targetRef"]["name"] == "w1"
+        assert ep["subsets"][0]["ports"][0]["port"] == 8080
+
+
+class TestNamespaceLifecycle:
+    def test_terminating_namespace_sweeps_content(self, client, api, cm):
+        client.namespaces.create({"apiVersion": "v1", "kind": "Namespace",
+                                  "metadata": {"name": "team"}})
+        client.pods.create({"apiVersion": "v1", "kind": "Pod",
+                            "metadata": {"name": "p", "namespace": "team"},
+                            "spec": {"containers": [{"name": "c"}]}})
+        api.delete_namespace("team")
+        assert wait_for(lambda: not _exists(client.namespaces, "team", ""))
+        assert client.pods.list("team")["items"] == []
+
+
+class TestGCAndPodGC:
+    def test_orphaned_pods_collected(self, client, cm):
+        rs = {"apiVersion": "apps/v1", "kind": "ReplicaSet",
+              "metadata": {"name": "short", "namespace": "default"},
+              "spec": {"replicas": 2,
+                       "selector": {"matchLabels": {"app": "short"}},
+                       "template": {"metadata": {"labels": {"app": "short"}},
+                                    "spec": {"containers": [{"name": "c"}]}}}}
+        client.replicasets.create(rs)
+        assert wait_for(lambda: len(client.pods.list(
+            "default", label_selector="app=short")["items"]) == 2)
+        client.replicasets.delete("short")
+        assert wait_for(lambda: client.pods.list(
+            "default", label_selector="app=short")["items"] == [], timeout=15)
+
+    def test_pods_on_missing_node_removed(self, client, cm):
+        client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "ghost", "namespace": "default"},
+            "spec": {"containers": [{"name": "c"}], "nodeName": "gone-node"}})
+        assert wait_for(lambda: not _exists(client.pods, "ghost"), timeout=15)
+
+
+class TestNodeLifecycle:
+    def test_stale_heartbeat_taints_and_evicts(self, client):
+        fake_now = [1000.0]
+        factory = InformerFactory(client)
+        nlc = NodeLifecycleController(client, factory, monitor_grace=30.0,
+                                      default_eviction_wait=60.0,
+                                      clock=lambda: fake_now[0])
+        factory.start()
+        factory.wait_for_sync()
+        client.nodes.create({
+            "apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"},
+            "status": {"conditions": [{"type": "Ready", "status": "True",
+                                       "heartbeatUnix": 1000.0}]}})
+        client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "victim", "namespace": "default"},
+            "spec": {"containers": [{"name": "c"}], "nodeName": "n1"}})
+        time.sleep(0.4)
+        nlc.poll_once()  # fresh heartbeat: nothing happens
+        assert "taints" not in client.nodes.get("n1", "").get("spec", {})
+        fake_now[0] = 1050.0  # past the 30 s grace
+        nlc.poll_once()
+        node = client.nodes.get("n1", "")
+        assert any(t["key"] == TAINT_UNREACHABLE
+                   for t in node["spec"]["taints"])
+        assert any(c["type"] == "Ready" and c["status"] == "Unknown"
+                   for c in node["status"]["conditions"])
+        # eviction after the toleration window
+        fake_now[0] = 1200.0
+        time.sleep(0.3)  # let the informer see the taint
+        nlc.poll_once()
+        assert wait_for(lambda: not _exists(client.pods, "victim"))
+        # recovery: heartbeat resumes → taint removed
+        node = client.nodes.get("n1", "")
+        node["status"]["conditions"][0]["heartbeatUnix"] = 1199.0
+        client.nodes.update_status(node, "")
+        time.sleep(0.3)
+        nlc.poll_once()
+        assert not client.nodes.get("n1", "").get("spec", {}).get("taints")
+        factory.stop()
+
+
+class TestDisruptionAndQuota:
+    def test_pdb_status(self, client, cm):
+        client.poddisruptionbudgets.create({
+            "apiVersion": "policy/v1beta1", "kind": "PodDisruptionBudget",
+            "metadata": {"name": "pdb", "namespace": "default"},
+            "spec": {"minAvailable": 1,
+                     "selector": {"matchLabels": {"app": "guarded"}}}})
+        for i in range(2):
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"g{i}", "namespace": "default",
+                             "labels": {"app": "guarded"}},
+                "spec": {"containers": [{"name": "c"}]}})
+        mark_pods_running(client, selector="app=guarded")
+        assert wait_for(lambda: client.poddisruptionbudgets.get("pdb")
+                        .get("status", {}).get("disruptionsAllowed") == 1)
+
+    def test_quota_usage(self, client, cm):
+        client.resourcequotas.create({
+            "apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": {"name": "q", "namespace": "default"},
+            "spec": {"hard": {"pods": "10", "requests.cpu": "4"}}})
+        client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "qp", "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "c", "resources": {"requests": {"cpu": "500m"}}}]}})
+        assert wait_for(lambda: client.resourcequotas.get("q")
+                        .get("status", {}).get("used", {}).get("pods") == "1")
+        used = client.resourcequotas.get("q")["status"]["used"]
+        assert used["requests.cpu"] == "500m"
+
+
+class TestCronJob:
+    def test_spawns_jobs_on_cadence(self, client):
+        fake_now = [0.0]
+        factory = InformerFactory(client)
+        from kubernetes_tpu.controllers import CronJobController
+        cjc = CronJobController(client, factory, clock=lambda: fake_now[0])
+        factory.start()
+        factory.wait_for_sync()
+        client.cronjobs.create({
+            "apiVersion": "batch/v1beta1", "kind": "CronJob",
+            "metadata": {"name": "tick", "namespace": "default"},
+            "spec": {"schedule": "@every 60s",
+                     "jobTemplate": {"spec": {
+                         "template": {"spec": {"containers": [{"name": "c"}],
+                                               "restartPolicy": "Never"}}}}}})
+        time.sleep(0.3)
+        fake_now[0] = 61.0
+        cjc.poll_once()
+        jobs = client.jobs.list("default")["items"]
+        assert len(jobs) == 1
+        assert (meta.controller_ref(jobs[0]) or {}).get("kind") == "CronJob"
+        # within the period: no second job
+        fake_now[0] = 90.0
+        time.sleep(0.3)
+        cjc.poll_once()
+        assert len(client.jobs.list("default")["items"]) == 1
+        factory.stop()
+
+
+def _exists(rc, name, ns="default"):
+    try:
+        rc.get(name, ns)
+        return True
+    except errors.StatusError:
+        return False
