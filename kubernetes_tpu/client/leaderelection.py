@@ -93,7 +93,7 @@ class LeaderElector:
     def _record(self, now: float, transitions: int = 0,
                 acquire: Optional[float] = None) -> dict:
         return {"holderIdentity": self.cfg.identity,
-                "leaseDurationSeconds": int(self.cfg.lease_duration),
+                "leaseDurationSeconds": self.cfg.lease_duration,
                 "acquireTime": acquire if acquire is not None else now,
                 "renewTime": now,
                 "leaseTransitions": transitions}
