@@ -17,6 +17,55 @@ from kubernetes_tpu.client.workqueue import RateLimitingQueue
 from kubernetes_tpu.machinery import meta
 
 
+class Expectations:
+    """controller_utils.ControllerExpectations: remember how many child
+    creations/deletions a sync dispatched and hold further syncs until the
+    informer has observed them — the guard against over-creating children on
+    stale lister reads (controller_utils.go:150-260)."""
+
+    TIMEOUT = 300.0  # ExpectationsTimeout: 5 minutes
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._data: Dict[str, List[float]] = {}  # key -> [adds, dels, stamp]
+
+    def expect_creations(self, key: str, n: int) -> None:
+        with self._mu:
+            import time as _t
+            self._data[key] = [float(n), 0.0, _t.monotonic()]
+
+    def expect_deletions(self, key: str, n: int) -> None:
+        with self._mu:
+            import time as _t
+            self._data[key] = [0.0, float(n), _t.monotonic()]
+
+    def creation_observed(self, key: str) -> None:
+        with self._mu:
+            e = self._data.get(key)
+            if e is not None:
+                e[0] -= 1
+
+    def deletion_observed(self, key: str) -> None:
+        with self._mu:
+            e = self._data.get(key)
+            if e is not None:
+                e[1] -= 1
+
+    def satisfied(self, key: str) -> bool:
+        with self._mu:
+            e = self._data.get(key)
+            if e is None:
+                return True
+            import time as _t
+            if e[0] <= 0 and e[1] <= 0:
+                return True
+            return _t.monotonic() - e[2] > self.TIMEOUT  # expired → resync
+
+    def forget(self, key: str) -> None:
+        with self._mu:
+            self._data.pop(key, None)
+
+
 class Controller:
     """Base: wire informers to a keyed queue; run workers over sync(key)."""
 
@@ -47,20 +96,44 @@ class Controller:
         inf.add_handlers(on_add=fn, on_update=lambda o, n: fn(n), on_delete=fn)
         return inf
 
-    def watch_owned(self, attr: str, owner_kind: str) -> SharedInformer:
+    def watch_owned(self, attr: str, owner_kind: str,
+                    expectations: Optional[Expectations] = None) -> SharedInformer:
         """Enqueue the controller owner of changed children
-        (resolveControllerRef, replica_set.go:319)."""
+        (resolveControllerRef, replica_set.go:319). With expectations, child
+        add/delete events lower the owner's pending counts first
+        (replica_set.go addPod/deletePod → expectations.CreationObserved)."""
 
-        def enqueue_owner(obj: Dict) -> None:
+        def owner_key(obj: Dict) -> Optional[str]:
             ref = meta.controller_ref(obj)
             if ref is not None and ref.get("kind") == owner_kind:
                 ns = meta.namespace(obj)
-                self.enqueue_key(f"{ns}/{ref['name']}" if ns else ref["name"])
+                return f"{ns}/{ref['name']}" if ns else ref["name"]
+            return None
+
+        def on_add(obj: Dict) -> None:
+            key = owner_key(obj)
+            if key is None:
+                return
+            if expectations is not None:
+                expectations.creation_observed(key)
+            self.enqueue_key(key)
+
+        def on_delete(obj: Dict) -> None:
+            key = owner_key(obj)
+            if key is None:
+                return
+            if expectations is not None:
+                expectations.deletion_observed(key)
+            self.enqueue_key(key)
+
+        def on_update(old: Dict, new: Dict) -> None:
+            key = owner_key(new)
+            if key is not None:
+                self.enqueue_key(key)
 
         inf = self.factory.informer(attr)
-        inf.add_handlers(on_add=enqueue_owner,
-                         on_update=lambda o, n: enqueue_owner(n),
-                         on_delete=enqueue_owner)
+        inf.add_handlers(on_add=on_add, on_update=on_update,
+                         on_delete=on_delete)
         return inf
 
     # -- lifecycle ---------------------------------------------------------- #

@@ -102,8 +102,17 @@ class ControllerManager:
     def _poll_loop(self, stop: threading.Event) -> None:
         """Periodic sweeps for poll-driven controllers (node monitor 5 s,
         cronjob 10 s, podgc 20 s in the reference). `stop` is this term's
-        event so a previous term's poll thread exits on leadership change."""
+        event so a previous term's poll thread exits on leadership change.
+        Every 10th tick also re-enqueues everything — the informer resync
+        that repairs any event-ordering gap (shared_informer resyncPeriod)."""
+        tick = 0
         while not stop.wait(self.poll_interval):
+            tick += 1
+            if tick % 10 == 0:
+                try:
+                    self.resync()
+                except Exception:  # noqa: BLE001
+                    pass
             for name in ("nodelifecycle", "cronjob", "podgc"):
                 c = self.controllers.get(name)
                 if c is not None and hasattr(c, "poll_once"):

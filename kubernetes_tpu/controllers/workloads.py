@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 from kubernetes_tpu.client.informers import InformerFactory, pods_by_node_index
 from kubernetes_tpu.controllers.base import (
     Controller,
+    Expectations,
     is_pod_active,
     is_pod_ready,
     pod_from_template,
@@ -40,8 +41,10 @@ class ReplicaSetController(Controller):
         super().__init__(client, factory)
         self.attr = attr
         self.owner_kind = owner_kind
+        self.expectations = Expectations()
         self.rs_informer = self.watch_resource(attr)
-        self.pod_informer = self.watch_owned("pods", owner_kind)
+        self.pod_informer = self.watch_owned("pods", owner_kind,
+                                             expectations=self.expectations)
 
     def _rc(self):
         return getattr(self.client, self.attr)
@@ -50,9 +53,13 @@ class ReplicaSetController(Controller):
         ns, name = meta.split_key(key)
         rs = self.rs_informer.lister.get(ns, name)
         if rs is None:
+            self.expectations.forget(key)
             return
         if meta.is_being_deleted(rs):
             return
+        if not self.expectations.satisfied(key):
+            return  # prior creations/deletions not yet observed; event-driven
+            # observation re-enqueues this key (replica_set.go:610 needsSync)
         spec = rs.get("spec", {})
         desired = int(spec.get("replicas", 1))
         match = _selector_fn(spec.get("selector")
@@ -67,19 +74,30 @@ class ReplicaSetController(Controller):
 
         diff = desired - len(pods)
         if diff > 0:
-            for _ in range(min(diff, self.burst_replicas)):
-                self.client.pods.create(
-                    pod_from_template(rs, spec.get("template", {})), ns)
+            n = min(diff, self.burst_replicas)
+            self.expectations.expect_creations(key, n)
+            created = 0
+            for _ in range(n):
+                try:
+                    self.client.pods.create(
+                        pod_from_template(rs, spec.get("template", {})), ns)
+                    created += 1
+                except errors.StatusError:
+                    break
+            for _ in range(n - created):  # lower expectations for failures
+                self.expectations.creation_observed(key)
         elif diff < 0:
             # prefer deleting not-ready/youngest (getPodsToDelete ranking)
             victims = sorted(
                 pods, key=lambda p: (is_pod_ready(p),
                                      p["metadata"].get("creationTimestamp", "")))
-            for p in victims[:(-diff)]:
+            victims = victims[:(-diff)]
+            self.expectations.expect_deletions(key, len(victims))
+            for p in victims:
                 try:
                     self.client.pods.delete(meta.name(p), ns)
                 except errors.StatusError:
-                    pass
+                    self.expectations.deletion_observed(key)
 
         ready = sum(1 for p in pods if is_pod_ready(p))
         status = {
@@ -414,14 +432,19 @@ class JobController(Controller):
 
     def __init__(self, client, factory: InformerFactory):
         super().__init__(client, factory)
+        self.expectations = Expectations()
         self.job_informer = self.watch_resource("jobs")
-        self.pod_informer = self.watch_owned("pods", "Job")
+        self.pod_informer = self.watch_owned("pods", "Job",
+                                             expectations=self.expectations)
 
     def sync(self, key: str) -> None:
         ns, name = meta.split_key(key)
         job = self.job_informer.lister.get(ns, name)
         if job is None or meta.is_being_deleted(job):
+            self.expectations.forget(key)
             return
+        if not self.expectations.satisfied(key):
+            return  # await informer observation of dispatched creations
         spec = job.get("spec", {})
         completions = int(spec.get("completions", 1))
         parallelism = int(spec.get("parallelism", 1))
@@ -454,9 +477,20 @@ class JobController(Controller):
                                    "lastTransitionTime": meta.now_rfc3339()})
             else:
                 want_active = min(parallelism, completions - succeeded)
-                for _ in range(max(0, want_active - len(active))):
-                    self.client.pods.create(
-                        pod_from_template(job, spec.get("template", {})), ns)
+                n = max(0, want_active - len(active))
+                if n:
+                    self.expectations.expect_creations(key, n)
+                    created = 0
+                    for _ in range(n):
+                        try:
+                            self.client.pods.create(
+                                pod_from_template(job,
+                                                  spec.get("template", {})), ns)
+                            created += 1
+                        except errors.StatusError:
+                            break
+                    for _ in range(n - created):
+                        self.expectations.creation_observed(key)
 
         status = {"active": len(active), "succeeded": succeeded,
                   "failed": failed, "conditions": conditions}

@@ -1,0 +1,21 @@
+"""Node agent: kubelet, fake CRI, checkpoint manager.
+
+TPU-native analog of SURVEY.md layer 8 (`pkg/kubelet`, `cmd/kubelet`,
+`staging/src/k8s.io/cri-api`).
+"""
+
+from kubernetes_tpu.kubelet.checkpoint import (
+    CheckpointManager,
+    CorruptCheckpointError,
+)
+from kubernetes_tpu.kubelet.cri import (
+    CONTAINER_CREATED,
+    CONTAINER_EXITED,
+    CONTAINER_RUNNING,
+    FakeCRI,
+)
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+
+__all__ = ["CheckpointManager", "CorruptCheckpointError",
+           "CONTAINER_CREATED", "CONTAINER_EXITED", "CONTAINER_RUNNING",
+           "FakeCRI", "Kubelet"]

@@ -1,0 +1,318 @@
+"""The node agent: syncLoop → pod workers → CRI; status + heartbeats.
+
+Analog of `pkg/kubelet/kubelet.go`: `Run` (:1395) registers the node and
+starts the loops; `syncLoop`/`syncLoopIteration` (:1818,:1892) select over
+the pod config source (here: a watch on pods bound to this node), PLEG
+events, and housekeeping ticks; `syncPod` (:1478) drives the CRI. Status
+writes go through a status manager that dedupes; node heartbeats ride the
+Ready condition + a kube-node-lease Lease, which the nodelifecycle
+controller consumes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from kubernetes_tpu.client.informers import SharedInformer
+from kubernetes_tpu.kubelet.checkpoint import CheckpointManager
+from kubernetes_tpu.kubelet.cri import (
+    CONTAINER_EXITED,
+    CONTAINER_RUNNING,
+    FakeCRI,
+)
+from kubernetes_tpu.machinery import errors, meta
+
+Obj = Dict[str, Any]
+
+
+class Kubelet:
+    """One node agent. `hollow=True` is the kubemark configuration: fake CRI,
+    real everything else (hollow-node.go)."""
+
+    def __init__(self, client, node_name: str,
+                 capacity: Optional[Dict[str, str]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 cri: Optional[FakeCRI] = None,
+                 heartbeat_interval: float = 10.0,
+                 housekeeping_interval: float = 0.5,
+                 checkpoint_dir: Optional[str] = None,
+                 clock=time.time):
+        self.client = client
+        self.node_name = node_name
+        self.capacity = capacity or {"cpu": "8", "memory": "16Gi",
+                                     "pods": "110"}
+        self.labels = dict(labels or {})
+        self.labels.setdefault("kubernetes.io/hostname", node_name)
+        self.cri = cri or FakeCRI()
+        self.heartbeat_interval = heartbeat_interval
+        self.housekeeping_interval = housekeeping_interval
+        self.clock = clock
+        self.checkpoints = CheckpointManager(checkpoint_dir) \
+            if checkpoint_dir else None
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._informer: Optional[SharedInformer] = None
+        self._status_mu = threading.Lock()
+        self._last_status: Dict[str, Obj] = {}  # pod key → last written status
+        # serializes syncPod across the informer and housekeeping threads
+        # (the reference gives each pod a single worker goroutine)
+        self._pod_mu = threading.Lock()
+        self._sandbox_by_uid: Dict[str, str] = {}
+        self._containers_by_uid: Dict[str, List[str]] = {}
+
+    # ------------------------------------------------------------------ #
+    # node registration + heartbeat (kubelet_node_status.go)
+    # ------------------------------------------------------------------ #
+
+    def register_node(self) -> None:
+        node = {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": self.node_name, "labels": dict(self.labels)},
+            "spec": {},
+            "status": {
+                "capacity": dict(self.capacity),
+                "allocatable": dict(self.capacity),
+                "conditions": [self._ready_condition()],
+                "nodeInfo": {"kubeletVersion": "v1.17.0-tpu.1"},
+                "addresses": [{"type": "Hostname",
+                               "address": self.node_name}],
+            },
+        }
+        try:
+            self.client.nodes.create(node)
+        except errors.StatusError as e:
+            if not errors.is_already_exists(e):
+                raise
+            # re-registration keeps the existing object, refreshes status
+            self._heartbeat()
+
+    def _ready_condition(self) -> Obj:
+        return {"type": "Ready", "status": "True", "reason": "KubeletReady",
+                "heartbeatUnix": self.clock(),
+                "lastHeartbeatTime": meta.now_rfc3339()}
+
+    def _heartbeat(self) -> None:
+        try:
+            node = self.client.nodes.get(self.node_name, "")
+            conds = [c for c in node.get("status", {}).get("conditions", [])
+                     if c.get("type") != "Ready"]
+            conds.append(self._ready_condition())
+            node.setdefault("status", {})["conditions"] = conds
+            node["status"]["capacity"] = dict(self.capacity)
+            node["status"].setdefault("allocatable", dict(self.capacity))
+            self.client.nodes.update_status(node, "")
+        except errors.StatusError:
+            pass
+        # node lease (kube-node-lease), the cheap heartbeat path
+        lease = {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": self.node_name,
+                         "namespace": "kube-node-lease"},
+            "spec": {"holderIdentity": self.node_name,
+                     "renewTime": self.clock(),
+                     "leaseDurationSeconds": 40}}
+        try:
+            cur = self.client.leases.get(self.node_name, "kube-node-lease")
+            cur["spec"] = lease["spec"]
+            self.client.leases.update(cur, "kube-node-lease")
+        except errors.StatusError:
+            try:
+                self.client.leases.create(lease, "kube-node-lease")
+            except errors.StatusError:
+                pass
+
+    # ------------------------------------------------------------------ #
+    # syncLoop (kubelet.go:1818): pod source + PLEG + housekeeping
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> "Kubelet":
+        self.register_node()
+        self._informer = SharedInformer(
+            self.client.pods,
+            field_selector=f"spec.nodeName={self.node_name}")
+        self._informer.add_handlers(
+            on_add=self._pod_changed,
+            on_update=lambda o, n: self._pod_changed(n),
+            on_delete=self._pod_deleted)
+        self._informer.start()
+        self._informer.wait_for_sync()
+        for target, name, period in (
+                (self._heartbeat_loop, "heartbeat", None),
+                (self._housekeeping_loop, "housekeeping", None)):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"kubelet-{self.node_name}-{name}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._informer is not None:
+            self._informer.stop()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _heartbeat_loop(self) -> None:
+        self._heartbeat()
+        while not self._stop.wait(self.heartbeat_interval):
+            self._heartbeat()
+
+    def _housekeeping_loop(self) -> None:
+        """PLEG relist + pod reconciliation (syncLoopIteration's 1 s/2 s
+        housekeeping + PLEG channels collapsed into one tick). The loop body
+        is guarded — a raising sync must not kill the node's PLEG forever."""
+        while not self._stop.wait(self.housekeeping_interval):
+            try:
+                self.cri.tick()
+                # reconcile every pod each tick, not only on CRI changes: a
+                # conflicted status write would otherwise never retry (the
+                # status dedupe map makes the no-change case free)
+                for pod in list(self._informer.lister.list()):
+                    self._pod_changed(pod)
+            except Exception:  # noqa: BLE001 — node loops never die
+                pass
+
+    # ------------------------------------------------------------------ #
+    # syncPod (kubelet.go:1478) — one pod's reconcile against the CRI
+    # ------------------------------------------------------------------ #
+
+    def _pod_changed(self, pod: Obj) -> None:
+        if meta.is_being_deleted(pod):
+            self._teardown(pod, deleted_from_api=False)
+            return
+        uid = meta.uid(pod)
+        phase = pod.get("status", {}).get("phase", "")
+        if phase in ("Succeeded", "Failed"):
+            return
+        with self._pod_mu:
+            sid = self._sandbox_by_uid.get(uid)
+            if sid is None:
+                sid = self.cri.run_pod_sandbox(meta.name(pod),
+                                               meta.namespace(pod), uid)
+                self._sandbox_by_uid[uid] = sid
+                cids = []
+                for c in pod.get("spec", {}).get("containers", []) or []:
+                    cid = self.cri.create_container(sid, c.get("name", "c"),
+                                                    c.get("image", ""))
+                    self.cri.start_container(cid)
+                    cids.append(cid)
+                self._containers_by_uid[uid] = cids
+                if self.checkpoints:
+                    self.checkpoints.create_checkpoint(
+                        f"pod-{uid}", {"sandbox": sid, "containers": cids})
+            else:
+                self._restart_failed_containers(pod, uid)
+        self._write_status(pod)
+
+    def _restart_failed_containers(self, pod: Obj, uid: str) -> None:
+        """Container restarts per restartPolicy (SyncPod's computePodActions):
+        Always restarts any exit; OnFailure restarts nonzero exits."""
+        policy = pod.get("spec", {}).get("restartPolicy", "Always")
+        if policy == "Never":
+            return
+        for cid in self._containers_by_uid.get(uid, []):
+            c = self.cri.container_status(cid)
+            if c is None or c.state != CONTAINER_EXITED:
+                continue
+            if policy == "Always" or c.exit_code != 0:
+                self.cri.start_container(cid)
+
+    def _pod_deleted(self, pod: Obj) -> None:
+        self._teardown(pod, deleted_from_api=True)
+
+    def _teardown(self, pod: Obj, deleted_from_api: bool) -> None:
+        uid = meta.uid(pod)
+        with self._pod_mu:
+            sid = self._sandbox_by_uid.pop(uid, None)
+            self._containers_by_uid.pop(uid, None)
+        with self._status_mu:
+            self._last_status.pop(meta.namespaced_key(pod), None)
+        if sid is not None:
+            self.cri.stop_pod_sandbox(sid)
+            self.cri.remove_pod_sandbox(sid)
+        if self.checkpoints:
+            self.checkpoints.remove_checkpoint(f"pod-{uid}")
+        if not deleted_from_api and meta.is_being_deleted(pod):
+            # confirm graceful deletion (the kubelet's final delete with
+            # grace 0 once containers are down, status_manager.go)
+            try:
+                self.client.pods.delete(meta.name(pod), meta.namespace(pod))
+            except errors.StatusError:
+                pass
+
+    # ------------------------------------------------------------------ #
+    # status manager (pkg/kubelet/status): compute + dedupe + write
+    # ------------------------------------------------------------------ #
+
+    def _compute_status(self, pod: Obj) -> Obj:
+        uid = meta.uid(pod)
+        sb = self.cri.sandbox_for_pod(uid)
+        cids = self._containers_by_uid.get(uid, [])
+        statuses = []
+        n_running = n_succeeded = n_failed = 0
+        for cid in cids:
+            c = self.cri.container_status(cid)
+            if c is None:
+                continue
+            if c.state == CONTAINER_RUNNING:
+                n_running += 1
+                statuses.append({"name": c.name, "ready": True,
+                                 "state": {"running": {}},
+                                 "restartCount": 0, "image": c.image})
+            elif c.state == CONTAINER_EXITED:
+                if c.exit_code == 0:
+                    n_succeeded += 1
+                else:
+                    n_failed += 1
+                statuses.append({"name": c.name, "ready": False,
+                                 "state": {"terminated":
+                                           {"exitCode": c.exit_code}},
+                                 "restartCount": 0, "image": c.image})
+        # PodPhase rules (pkg/kubelet/kubelet_pods.go getPhase): all
+        # succeeded → Succeeded; any failed with restartPolicy Never →
+        # Failed; otherwise Running while anything runs or will restart
+        total = len(cids)
+        policy = pod.get("spec", {}).get("restartPolicy", "Always")
+        if total and n_succeeded == total:
+            phase = "Succeeded"
+        elif n_failed and policy == "Never":
+            phase = "Failed"
+        elif n_running or (n_failed and policy == "OnFailure"):
+            # failed-under-OnFailure counts as Running: the kubelet restarts
+            # the container (see _restart_failed_containers)
+            phase = "Running"
+        else:
+            phase = "Pending"
+        ready = phase == "Running" and n_running == total
+        return {
+            "phase": phase,
+            "podIP": sb.ip if sb else "",
+            "hostIP": self.node_name,
+            "conditions": [
+                {"type": "PodScheduled", "status": "True"},
+                {"type": "Ready", "status": "True" if ready else "False"},
+                {"type": "ContainersReady",
+                 "status": "True" if ready else "False"},
+            ],
+            "containerStatuses": statuses,
+            "startTime": pod.get("status", {}).get("startTime")
+            or meta.now_rfc3339(),
+        }
+
+    def _write_status(self, pod: Obj) -> None:
+        key = meta.namespaced_key(pod)
+        status = self._compute_status(pod)
+        with self._status_mu:
+            if self._last_status.get(key) == status:
+                return
+        cur = meta.deep_copy(pod)
+        # keep scheduler-written conditions (PodScheduled) that we restate
+        cur["status"] = {**pod.get("status", {}), **status}
+        try:
+            self.client.pods.update_status(cur, meta.namespace(pod))
+        except errors.StatusError:
+            return  # NOT cached: a failed write must be retried next sync
+        with self._status_mu:
+            self._last_status[key] = status

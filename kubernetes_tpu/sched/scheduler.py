@@ -67,6 +67,8 @@ class CycleStats:
     bind_errors: int = 0
     cycle_seconds: float = 0.0
     assignments: Dict[str, str] = field(default_factory=dict)
+    # pod keys that failed this wave (feeds FailedScheduling events)
+    failed_keys: List[str] = field(default_factory=list)
 
 
 class Scheduler:
@@ -244,6 +246,7 @@ class Scheduler:
                 handled = self.preemptor.try_preempt(self, pod, attempts, fresh, now)
             if not handled:
                 stats.unschedulable += 1
+                stats.failed_keys.append(pod.key)
                 self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
 
         for pod, attempts in ext_batch:
@@ -310,6 +313,7 @@ class Scheduler:
                 handled = self.preemptor.try_preempt(self, pod, attempts, fresh, now)
             if not handled:
                 stats.unschedulable += 1
+                stats.failed_keys.append(pod.key)
                 self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
             return
 
@@ -347,6 +351,7 @@ class Scheduler:
                 stats.bind_errors += 1
             else:
                 stats.unschedulable += 1
+            stats.failed_keys.append(pod.key)
             self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
 
         if fw is not None:

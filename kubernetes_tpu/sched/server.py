@@ -1,0 +1,204 @@
+"""kube-scheduler, the process: informer wiring + scheduling loop + binder.
+
+Analog of `cmd/kube-scheduler/app/server.go` (Run :167) +
+`pkg/scheduler/eventhandlers.go` (AddAllEventHandlers :335): watches pods
+and nodes, feeds the batched TPU scheduling core
+(kubernetes_tpu.sched.scheduler.Scheduler), binds via the pods/binding
+subresource, records FailedScheduling events, and optionally runs behind
+leader election like the reference binary (:254-260).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.api.v1 import node_from_v1, pod_from_v1
+from kubernetes_tpu.client.events import EventRecorder
+from kubernetes_tpu.client.informers import SharedInformer
+from kubernetes_tpu.client.leaderelection import (
+    LeaderElectionConfig,
+    LeaderElector,
+)
+from kubernetes_tpu.machinery import errors, meta
+from kubernetes_tpu.sched.scheduler import Scheduler
+
+Obj = Dict[str, Any]
+
+
+class APIBinder:
+    """Binder over POST pods/{name}/binding (scheduler.go:565)."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def bind(self, pod: Pod, node_name: str) -> bool:
+        try:
+            self.client.pods.bind(pod.name, node_name, pod.namespace,
+                                  uid=pod.uid)
+            return True
+        except errors.StatusError:
+            return False
+
+
+class SchedulerServer:
+    """The scheduler process: New + Run (scheduler.go:255,425-431)."""
+
+    def __init__(self, client, scheduler: Optional[Scheduler] = None,
+                 scheduler_name: str = "default-scheduler",
+                 cycle_interval: float = 0.05,
+                 leader_elect: bool = False):
+        self.client = client
+        self.recorder = EventRecorder(client, component=scheduler_name)
+        self.scheduler = scheduler or Scheduler(
+            binder=APIBinder(client), scheduler_name=scheduler_name)
+        if self.scheduler.binder is None:
+            self.scheduler.binder = APIBinder(client)
+        self.cycle_interval = cycle_interval
+        self._creation_seq = 0
+        self._stop = threading.Event()
+        self._threads = []
+        self._mu = threading.Lock()  # serializes event handlers vs waves
+        self.pod_informer: Optional[SharedInformer] = None
+        self.node_informer: Optional[SharedInformer] = None
+        self.elector: Optional[LeaderElector] = None
+        self._active = threading.Event()
+        if leader_elect:
+            self.elector = LeaderElector(client, LeaderElectionConfig(
+                lock_name="kube-scheduler",
+                on_started_leading=self._active.set,
+                on_stopped_leading=self._active.clear))
+        else:
+            self._active.set()
+        self.total_scheduled = 0
+        self.total_unschedulable_events = 0
+
+    # -- conversion --------------------------------------------------------- #
+
+    def _to_pod(self, obj: Obj) -> Pod:
+        pod = pod_from_v1(obj)
+        # stable FIFO-within-priority ordering (creationTimestamp analog)
+        self._creation_seq += 1
+        pod.creation_index = self._creation_seq
+        return pod
+
+    @staticmethod
+    def _schedulable(obj: Obj) -> bool:
+        phase = obj.get("status", {}).get("phase", "")
+        return phase not in ("Succeeded", "Failed") and \
+            not meta.is_being_deleted(obj)
+
+    # -- event handlers (eventhandlers.go:335-441) --------------------------- #
+
+    def _on_pod_add(self, obj: Obj) -> None:
+        if not self._schedulable(obj):
+            return
+        with self._mu:
+            self.scheduler.on_pod_add(self._to_pod(obj))
+
+    def _on_pod_update(self, old: Obj, new: Obj) -> None:
+        with self._mu:
+            if not self._schedulable(new):
+                p = pod_from_v1(new)
+                if p.node_name:
+                    # terminated on its node: free the resources
+                    if self.scheduler.cache.get_pod(p.key) is not None:
+                        self.scheduler.cache.remove_pod(p.key)
+                        self.scheduler.queue.move_all_to_active(
+                            self.scheduler.clock())
+                else:
+                    self.scheduler.queue.delete(p.key)
+                return
+            self.scheduler.on_pod_update(pod_from_v1(old), self._to_pod(new))
+
+    def _on_pod_delete(self, obj: Obj) -> None:
+        with self._mu:
+            self.scheduler.on_pod_delete(pod_from_v1(obj))
+
+    def _on_node_add(self, obj: Obj) -> None:
+        with self._mu:
+            self.scheduler.on_node_add(node_from_v1(obj))
+
+    def _on_node_update(self, old: Obj, new: Obj) -> None:
+        with self._mu:
+            self.scheduler.on_node_update(node_from_v1(new))
+
+    def _on_node_delete(self, obj: Obj) -> None:
+        with self._mu:
+            self.scheduler.on_node_delete(meta.name(obj))
+
+    # -- lifecycle ----------------------------------------------------------- #
+
+    def start(self) -> "SchedulerServer":
+        self.pod_informer = SharedInformer(self.client.pods)
+        self.pod_informer.add_handlers(on_add=self._on_pod_add,
+                                       on_update=self._on_pod_update,
+                                       on_delete=self._on_pod_delete)
+        self.node_informer = SharedInformer(self.client.nodes)
+        self.node_informer.add_handlers(on_add=self._on_node_add,
+                                        on_update=self._on_node_update,
+                                        on_delete=self._on_node_delete)
+        self.node_informer.start()
+        self.node_informer.wait_for_sync()
+        self.pod_informer.start()
+        self.pod_informer.wait_for_sync()
+        if self.elector is not None:
+            self.elector.start()
+        t = threading.Thread(target=self._loop, daemon=True,
+                             name="scheduler-loop")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.elector is not None:
+            self.elector.stop()
+        for inf in (self.pod_informer, self.node_informer):
+            if inf is not None:
+                inf.stop()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    # -- the loop (wait.Until(scheduleOne) → batched waves) ------------------ #
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._active.is_set():
+                self._stop.wait(0.2)
+                continue
+            stats = self.run_one_wave()
+            if stats is None or stats.attempted == 0:
+                self._stop.wait(self.cycle_interval)
+
+    def run_one_wave(self):
+        with self._mu:
+            try:
+                stats = self.scheduler.schedule_pending()
+            except Exception:  # noqa: BLE001 — the loop never dies
+                return None
+        self.total_scheduled += stats.scheduled
+        if stats.unschedulable:
+            self.total_unschedulable_events += stats.unschedulable
+        # FailedScheduling events, as scheduler.go:436-448 records on FitError
+        for key in stats.failed_keys:
+            ns, name = meta.split_key(key)
+            obj = self.pod_informer.lister.get(ns, name) \
+                if self.pod_informer else None
+            if obj is not None:
+                self.recorder.event(obj, "Warning", "FailedScheduling",
+                                    "no nodes available to schedule pod")
+        return stats
+
+    def wait_until_idle(self, timeout: float = 30.0) -> bool:
+        """Test helper: wait until no pods are pending in the active queue."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._mu:
+                active = self.scheduler.queue.lengths()[0]
+            if active == 0:
+                return True
+            time.sleep(0.05)
+        return False

@@ -41,6 +41,16 @@ def client(request, api):
         gw.stop()
 
 
+def wait_for_watch(inf, timeout=5.0):
+    """Poll until the informer's live watch exists (it is established after
+    _synced is set, so wait_for_sync alone does not guarantee it)."""
+    deadline = time.monotonic() + timeout
+    while inf._watch is None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert inf._watch is not None, "informer watch not established in time"
+    return inf._watch
+
+
 def mkpod(name, ns="default", node="", labels=None):
     p = {"apiVersion": "v1", "kind": "Pod",
          "metadata": {"name": name, "namespace": ns},
@@ -123,7 +133,7 @@ class TestInformer:
         inf.start()
         assert inf.wait_for_sync()
         # kill the live watch; the reflector must relist and keep going
-        inf._watch.stop()
+        wait_for_watch(inf).stop()
         time.sleep(0.5)
         client.pods.create(mkpod("after-relist"))
         time.sleep(0.8)
@@ -252,7 +262,7 @@ class TestRelistTombstones:
         inf.start()
         assert inf.wait_for_sync()
         # kill the watch, delete while the informer is blind, let it relist
-        inf._watch.stop()
+        wait_for_watch(inf).stop()
         client.pods.delete("t1")
         time.sleep(1.0)
         assert deletes, "relist did not synthesize the delete"
