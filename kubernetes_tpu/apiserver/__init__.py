@@ -4,9 +4,16 @@ TPU-native analog of SURVEY.md layer 4 (`cmd/kube-apiserver`,
 `staging/src/k8s.io/apiserver`, `pkg/registry`).
 """
 
+from kubernetes_tpu.apiserver.admission import AdmissionChain, AdmissionPlugin
+from kubernetes_tpu.apiserver.auth import (
+    AuthGate,
+    RBACAuthorizer,
+    TokenAuthenticator,
+)
 from kubernetes_tpu.apiserver.registry import Store, parse_field_selector
 from kubernetes_tpu.apiserver.resources import build_scheme
 from kubernetes_tpu.apiserver.server import APIServer, HTTPGateway, handle_rest
 
-__all__ = ["APIServer", "HTTPGateway", "Store", "build_scheme",
-           "handle_rest", "parse_field_selector"]
+__all__ = ["APIServer", "AdmissionChain", "AdmissionPlugin", "AuthGate",
+           "HTTPGateway", "RBACAuthorizer", "Store", "TokenAuthenticator",
+           "build_scheme", "handle_rest", "parse_field_selector"]

@@ -1,0 +1,143 @@
+"""CustomResourceDefinitions: dynamic resource registration + validation.
+
+Analog of `staging/src/k8s.io/apiextensions-apiserver`: a CRD object
+registers a new served resource at /apis/{group}/{version}/{plural} with
+structural-schema validation (the openAPIV3Schema subset that carries:
+type, properties, required, enum, minimum/maximum, items).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from kubernetes_tpu.machinery import meta
+from kubernetes_tpu.machinery.scheme import ResourceInfo
+
+Obj = Dict[str, Any]
+
+
+def validate_against_schema(value: Any, schema: Dict[str, Any],
+                            path: str = "") -> List[str]:
+    """Structural-schema validation (apiextensions pkg/apiserver/validation)."""
+    errs: List[str] = []
+    if not isinstance(schema, dict):
+        return errs
+    typ = schema.get("type")
+    if typ:
+        ok = {"object": dict, "array": list, "string": str,
+              "integer": int, "number": (int, float),
+              "boolean": bool}.get(typ)
+        if ok is not None and value is not None:
+            if typ == "integer" and isinstance(value, bool):
+                errs.append(f"{path or '.'}: expected integer")
+            elif not isinstance(value, ok):
+                errs.append(f"{path or '.'}: expected {typ}")
+                return errs
+    if "enum" in schema and value not in schema["enum"]:
+        errs.append(f"{path or '.'}: must be one of {schema['enum']}")
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        if "minimum" in schema and value < schema["minimum"]:
+            errs.append(f"{path or '.'}: must be >= {schema['minimum']}")
+        if "maximum" in schema and value > schema["maximum"]:
+            errs.append(f"{path or '.'}: must be <= {schema['maximum']}")
+    if isinstance(value, dict):
+        for req in schema.get("required", []) or []:
+            if req not in value:
+                errs.append(f"{path}.{req}: Required value")
+        props = schema.get("properties") or {}
+        for k, sub in props.items():
+            if k in value:
+                errs.extend(validate_against_schema(value[k], sub,
+                                                    f"{path}.{k}"))
+    if isinstance(value, list):
+        items = schema.get("items")
+        if items:
+            for i, item in enumerate(value):
+                errs.extend(validate_against_schema(item, items,
+                                                    f"{path}[{i}]"))
+    return errs
+
+
+def resource_info_from_crd(crd: Obj) -> Optional[ResourceInfo]:
+    """Build the served-resource registration from a CRD object."""
+    spec = crd.get("spec", {})
+    group = spec.get("group", "")
+    names = spec.get("names", {})
+    plural = names.get("plural", "")
+    kind = names.get("kind", "")
+    versions = spec.get("versions") or []
+    served = next((v for v in versions if v.get("served", True)), None)
+    if not (group and plural and kind and served):
+        return None
+    schema = ((served.get("schema") or {}).get("openAPIV3Schema")
+              or (spec.get("validation") or {}).get("openAPIV3Schema"))
+
+    def validator(obj: Obj) -> List[str]:
+        if not schema:
+            return []
+        # metadata is validated by the generic registry, not the schema
+        body = {k: v for k, v in obj.items()
+                if k not in ("apiVersion", "kind", "metadata")}
+        return validate_against_schema(body, schema)
+
+    return ResourceInfo(
+        group=group,
+        version=served.get("name", "v1"),
+        kind=kind,
+        resource=plural,
+        namespaced=spec.get("scope", "Namespaced") == "Namespaced",
+        list_kind=names.get("listKind", kind + "List"),
+        short_names=tuple(names.get("shortNames") or ()),
+        subresources=tuple(
+            s for s in ("status",)
+            if (served.get("subresources") or spec.get("subresources") or {})
+            .get(s) is not None),
+        validator=validator,
+    )
+
+
+def install_crd_hook(api) -> None:
+    """Wire the CRD store so creates/updates (re-)register the resource
+    immediately, deletes unserve it, and existing CRDs re-register on server
+    start (the apiextensions controller loop collapsed to its effect)."""
+    store = api.store("apiextensions.k8s.io", "customresourcedefinitions")
+
+    def register(crd: Obj) -> None:
+        info = resource_info_from_crd(crd)
+        if info is not None:
+            api.register_resource(info)
+            # mark Established, as the apiextensions status controller does
+            def establish(o: Obj) -> Obj:
+                conds = o.setdefault("status", {}).setdefault("conditions", [])
+                if not any(c.get("type") == "Established" for c in conds):
+                    conds.append({"type": "Established", "status": "True",
+                                  "reason": "InitialNamesAccepted"})
+                return o
+            try:
+                store.storage.guaranteed_update(
+                    store.key_for("", meta.name(crd)), establish,
+                    "customresourcedefinitions", meta.name(crd))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def unregister(crd: Obj) -> None:
+        info = resource_info_from_crd(crd)
+        if info is not None:
+            api.unregister_resource(info.group, info.resource)
+
+    def reregister(crd: Obj) -> None:
+        # update path: a changed schema replaces the validator immediately
+        info = resource_info_from_crd(crd)
+        if info is not None:
+            api.register_resource(info)
+
+    store.after_create = register
+    store.after_update = reregister
+    store.after_delete = unregister
+    # replay CRDs already persisted (server restart)
+    try:
+        items, _ = store.storage.list(store.key_root())
+        for crd in items:
+            register(crd)
+    except Exception:  # noqa: BLE001
+        pass

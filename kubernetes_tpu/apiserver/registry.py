@@ -71,12 +71,14 @@ class Store:
     def __init__(self, storage: Storage, scheme: Scheme, info: ResourceInfo,
                  admission: Optional[AdmissionFn] = None,
                  after_create: Optional[Callable[[Obj], None]] = None,
+                 after_update: Optional[Callable[[Obj], None]] = None,
                  after_delete: Optional[Callable[[Obj], None]] = None):
         self.storage = storage
         self.scheme = scheme
         self.info = info
         self.admission = admission
         self.after_create = after_create
+        self.after_update = after_update
         self.after_delete = after_delete
         self._name_seq = 0
         self._seq_mu = threading.Lock()
@@ -203,6 +205,8 @@ class Store:
         out = self.storage.guaranteed_update(
             self.key_for(namespace, name), apply, self.info.resource, name,
             expected_rv=expected_rv)
+        if self.after_update:
+            self.after_update(out)
         return self._finish_delete_if_ready(namespace, name, out)
 
     def patch(self, namespace: str, name: str, patch: Obj,
@@ -232,6 +236,8 @@ class Store:
 
         out = self.storage.guaranteed_update(self.key_for(namespace, name),
                                              apply, self.info.resource, name)
+        if self.after_update:
+            self.after_update(out)
         return self._finish_delete_if_ready(namespace, name, out)
 
     def delete(self, namespace: str, name: str,

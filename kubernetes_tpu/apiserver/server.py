@@ -40,13 +40,25 @@ VERSION_INFO = {
 
 
 class APIServer:
-    """The in-process REST engine: one Store per served resource."""
+    """The in-process REST engine: one Store per served resource.
+
+    admission: None installs the default plugin chain
+    (apiserver/admission.py); pass an explicit callable (or
+    `lambda op, info, obj, old: obj`) to override/disable.
+    """
 
     def __init__(self, storage: Optional[Storage] = None,
                  admission: Optional[AdmissionFn] = None,
                  scheme: Optional[Scheme] = None):
+        from kubernetes_tpu.apiserver.admission import AdmissionChain
+        from kubernetes_tpu.apiserver.crd import install_crd_hook
+
         self.storage = storage or Storage()
         self.scheme = scheme or build_scheme()
+        if admission is None:
+            admission = AdmissionChain()
+        if hasattr(admission, "attach"):
+            admission.attach(self)
         self.admission = admission
         self._stores: Dict[Tuple[str, str], Store] = {}
         for info in self.scheme.resources():
@@ -59,6 +71,7 @@ class APIServer:
                     "metadata": {"name": ns}})
             except errors.StatusError:
                 pass
+        install_crd_hook(self)
 
     def _install(self, info: ResourceInfo) -> Store:
         st = Store(self.storage, self.scheme, info, admission=self._admit)
@@ -94,6 +107,12 @@ class APIServer:
         self.scheme.register(info)
         return self._install(info)
 
+    def unregister_resource(self, group: str, resource: str) -> None:
+        """Dynamic removal (CRD deletion). Stored CR objects remain in the
+        backend but are no longer served, matching apiextensions."""
+        self.scheme.unregister(group, resource)
+        self._stores.pop((group, resource), None)
+
     # ------------------------------------------------------------------ #
     # subresources (registry/core/pod/storage: BindingREST, StatusREST …)
     # ------------------------------------------------------------------ #
@@ -126,13 +145,24 @@ class APIServer:
             "pods", name)
 
     def evict_pod(self, namespace: str, name: str, eviction: Obj) -> Obj:
-        """POST pods/{name}/eviction — PDB-gated delete. The PDB check
-        (disruption allowance) rides the admission chain when configured."""
+        """POST pods/{name}/eviction — PDB-gated delete. The gate decrements
+        the budget atomically; a failed delete credits the slot back so a
+        phantom eviction cannot pin the budget at zero."""
+        pod = None
         if self.admission is not None:
             pod = self.store("", "pods").get(namespace, name)
             self.admission("EVICT", self.scheme.lookup_resource("", "pods"),
                            eviction, pod)
-        return self.store("", "pods").delete(namespace, name)
+        try:
+            return self.store("", "pods").delete(namespace, name)
+        except errors.StatusError:
+            if pod is not None:
+                from kubernetes_tpu.apiserver.admission import (
+                    credit_pdb_disruption,
+                )
+
+                credit_pdb_disruption(self, pod)
+            raise
 
     def get_scale(self, group: str, resource: str, namespace: str,
                   name: str) -> Obj:
@@ -165,6 +195,9 @@ class APIServer:
         """Namespace delete = phase Terminating until spec.finalizers empties
         (registry/core/namespace/storage: Delete + FinalizeREST)."""
         st = self.store("", "namespaces")
+        if self.admission is not None:
+            cur = st.get("", name)
+            self.admission("DELETE", st.info, None, cur)
 
         def mark(o: Obj) -> Obj:
             if not o:
@@ -248,6 +281,10 @@ def handle_rest(api: APIServer, method: str, path: str,
     # non-resource endpoints
     if parts[0] in ("healthz", "readyz", "livez"):
         return 200, "ok"
+    if parts[0] == "metrics":
+        from kubernetes_tpu.component.metrics import DEFAULT_REGISTRY
+
+        return 200, DEFAULT_REGISTRY.expose_text()
     if parts[0] == "version":
         return 200, VERSION_INFO
     if parts[0] == "api" and len(parts) == 1:
@@ -357,6 +394,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _run(self, method: str) -> None:
         api: APIServer = self.server.api  # type: ignore[attr-defined]
+        auth_gate = getattr(self.server, "auth_gate", None)
         parsed = urlparse(self.path)
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         body: Optional[Obj] = None
@@ -368,6 +406,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, errors.new_bad_request("invalid JSON").status())
                 return
         try:
+            if auth_gate is not None:
+                auth_gate.check(method, parsed.path, query,
+                                dict(self.headers.items()))
             result = handle_rest(api, method, parsed.path, query, body)
         except errors.StatusError as e:
             self._reply(e.code, e.status())
@@ -445,10 +486,12 @@ class _ThreadingHTTPServer(socketserver.ThreadingMixIn, socketserver.TCPServer):
 class HTTPGateway:
     """Serve an APIServer over HTTP (the kube-apiserver process boundary)."""
 
-    def __init__(self, api: APIServer, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, api: APIServer, host: str = "127.0.0.1", port: int = 0,
+                 auth_gate=None):
         self.api = api
         self._httpd = _ThreadingHTTPServer((host, port), _Handler)
         self._httpd.api = api  # type: ignore[attr-defined]
+        self._httpd.auth_gate = auth_gate  # type: ignore[attr-defined]
         self.host, self.port = self._httpd.server_address
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="apiserver-http", daemon=True)

@@ -1,0 +1,10 @@
+"""CLI + cluster bootstrap.
+
+TPU-native analog of SURVEY.md layer 10 (`staging/src/k8s.io/kubectl`,
+`cmd/kubeadm`).
+"""
+
+from kubernetes_tpu.cli.cluster import Cluster, ClusterConfig
+from kubernetes_tpu.cli.kubectl import Kubectl, main
+
+__all__ = ["Cluster", "ClusterConfig", "Kubectl", "main"]

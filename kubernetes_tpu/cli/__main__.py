@@ -1,0 +1,11 @@
+"""`python -m kubernetes_tpu.cli ...` — kubectl verbs, plus `cluster up`."""
+
+import sys
+
+from kubernetes_tpu.cli.cluster import cluster_main
+from kubernetes_tpu.cli.kubectl import main
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "cluster":
+        sys.exit(cluster_main(sys.argv[2:]))
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Cluster bootstrap: kubeadm-init for the TPU-native control plane.
+
+Analog of `cmd/kubeadm` phases reduced to what a single-process control
+plane needs: bring up storage → apiserver (+HTTP gateway) → scheduler →
+controller-manager → (optionally) hollow nodes, in dependency order, with
+clean teardown. `python -m kubernetes_tpu.cli cluster up` serves until
+interrupted.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.apiserver import APIServer, HTTPGateway
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.kubemark import HollowCluster
+from kubernetes_tpu.sched.server import SchedulerServer
+
+
+@dataclass
+class ClusterConfig:
+    """The kubeadm ClusterConfiguration analog."""
+
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = ephemeral
+    hollow_nodes: int = 0
+    hollow_capacity: Dict[str, str] = field(default_factory=lambda: {
+        "cpu": "8", "memory": "16Gi", "pods": "110"})
+    leader_elect: bool = False
+    controllers: Optional[List[str]] = None
+    scheduler_name: str = "default-scheduler"
+
+
+class Cluster:
+    """All control-plane components in one process (the integration-test /
+    local-dev topology; each component still talks REST through the gateway
+    so the process boundary semantics hold)."""
+
+    def __init__(self, config: Optional[ClusterConfig] = None):
+        self.config = config or ClusterConfig()
+        self.api: Optional[APIServer] = None
+        self.gateway: Optional[HTTPGateway] = None
+        self.client: Optional[Client] = None
+        self.scheduler: Optional[SchedulerServer] = None
+        self.manager: Optional[ControllerManager] = None
+        self.hollow: Optional[HollowCluster] = None
+
+    # -- phases (kubeadm init workflow) ------------------------------------- #
+
+    def up(self) -> "Cluster":
+        cfg = self.config
+        self.api = APIServer()
+        self.gateway = HTTPGateway(self.api, host=cfg.host,
+                                   port=cfg.port).start()
+        self.client = Client.http(self.gateway.url)
+        self.scheduler = SchedulerServer(
+            self.client, scheduler_name=cfg.scheduler_name,
+            leader_elect=cfg.leader_elect).start()
+        self.manager = ControllerManager(
+            self.client, controllers=cfg.controllers,
+            leader_elect=cfg.leader_elect).start()
+        if cfg.hollow_nodes:
+            self.hollow = HollowCluster(
+                self.client, cfg.hollow_nodes,
+                capacity=cfg.hollow_capacity).start()
+        return self
+
+    def down(self) -> None:
+        for c in (self.hollow, self.manager, self.scheduler):
+            if c is not None:
+                c.stop()
+        if self.gateway is not None:
+            self.gateway.stop()
+        if self.api is not None:
+            self.api.close()
+
+    @property
+    def url(self) -> str:
+        return self.gateway.url if self.gateway else ""
+
+    def __enter__(self) -> "Cluster":
+        return self.up()
+
+    def __exit__(self, *exc) -> None:
+        self.down()
+
+
+def cluster_main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="cluster")
+    p.add_argument("action", choices=["up"])
+    p.add_argument("--port", type=int, default=6443)
+    p.add_argument("--hollow-nodes", type=int, default=0)
+    p.add_argument("--leader-elect", action="store_true")
+    args = p.parse_args(argv)
+    cluster = Cluster(ClusterConfig(port=args.port,
+                                    hollow_nodes=args.hollow_nodes,
+                                    leader_elect=args.leader_elect)).up()
+    print(f"control plane ready at {cluster.url} "
+          f"({args.hollow_nodes} hollow nodes)")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cluster.down()
+    return 0

@@ -1,0 +1,425 @@
+"""kubectl: the CLI verbs against the REST API.
+
+Analog of `staging/src/k8s.io/kubectl` (get/describe/create/apply/delete/
+scale/cordon/drain/label/taint/api-resources/version) over the same REST
+paths, with table printers and -o json|yaml|name|wide. Entry point:
+`python -m kubernetes_tpu.cli <verb> ...`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from kubernetes_tpu.client.rest import Client
+from kubernetes_tpu.machinery import errors, meta
+
+Obj = Dict[str, Any]
+
+
+# --------------------------------------------------------------------------- #
+# printers (kubectl's printers.HumanReadablePrinter, abbreviated columns)
+# --------------------------------------------------------------------------- #
+
+
+def _age(obj: Obj) -> str:
+    return obj.get("metadata", {}).get("creationTimestamp", "")[-9:-1] or "?"
+
+
+_COLUMNS = {
+    "pods": (("NAME", lambda o: meta.name(o)),
+             ("READY", lambda o: _pod_ready(o)),
+             ("STATUS", lambda o: o.get("status", {}).get("phase", "")),
+             ("NODE", lambda o: o.get("spec", {}).get("nodeName", "<none>"))),
+    "nodes": (("NAME", lambda o: meta.name(o)),
+              ("STATUS", lambda o: _node_status(o)),
+              ("TAINTS", lambda o: str(len(o.get("spec", {})
+                                          .get("taints", []) or []))),
+              ("CPU", lambda o: o.get("status", {}).get("capacity", {})
+               .get("cpu", "?"))),
+    "deployments": (("NAME", lambda o: meta.name(o)),
+                    ("READY", lambda o: f"{o.get('status', {}).get('readyReplicas', 0)}"
+                                        f"/{o.get('spec', {}).get('replicas', 0)}"),
+                    ("UP-TO-DATE", lambda o: str(o.get("status", {})
+                                                 .get("updatedReplicas", 0))),
+                    ("AVAILABLE", lambda o: str(o.get("status", {})
+                                                .get("availableReplicas", 0)))),
+    "services": (("NAME", lambda o: meta.name(o)),
+                 ("TYPE", lambda o: o.get("spec", {}).get("type", "")),
+                 ("CLUSTER-IP", lambda o: o.get("spec", {})
+                  .get("clusterIP", "<auto>")),
+                 ("PORTS", lambda o: ",".join(
+                     f"{p.get('port')}/{p.get('protocol', 'TCP')}"
+                     for p in o.get("spec", {}).get("ports", []) or []))),
+}
+
+_DEFAULT_COLUMNS = (("NAME", lambda o: meta.name(o)),
+                    ("AGE", _age))
+
+
+def _pod_ready(o: Obj) -> str:
+    cs = o.get("status", {}).get("containerStatuses", []) or []
+    total = len(o.get("spec", {}).get("containers", []) or [])
+    ready = sum(1 for c in cs if c.get("ready"))
+    return f"{ready}/{total}"
+
+
+def _node_status(o: Obj) -> str:
+    status = "NotReady"
+    for c in o.get("status", {}).get("conditions", []) or []:
+        if c.get("type") == "Ready":
+            status = {"True": "Ready", "False": "NotReady"}.get(
+                c.get("status"), "Unknown")
+    if o.get("spec", {}).get("unschedulable"):
+        status += ",SchedulingDisabled"
+    return status
+
+
+def print_table(resource: str, items: List[Obj], namespaced: bool,
+                all_namespaces: bool, out=sys.stdout) -> None:
+    cols = list(_COLUMNS.get(resource, _DEFAULT_COLUMNS))
+    if all_namespaces and namespaced:
+        cols.insert(0, ("NAMESPACE", lambda o: meta.namespace(o)))
+    rows = [[fn(o) for _, fn in cols] for o in items]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, (h, _) in enumerate(cols)]
+    out.write("  ".join(h.ljust(w) for (h, _), w in zip(cols, widths)).rstrip()
+              + "\n")
+    for r in rows:
+        out.write("  ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip()
+                  + "\n")
+
+
+def print_obj(obj: Obj, fmt: str, out=sys.stdout) -> None:
+    if fmt == "json":
+        json.dump(obj, out, indent=2)
+        out.write("\n")
+    elif fmt == "yaml":
+        yaml.safe_dump(obj, out, sort_keys=False)
+    elif fmt == "name":
+        out.write(f"{obj.get('kind', '').lower()}/{meta.name(obj)}\n")
+
+
+def describe(obj: Obj, out=sys.stdout) -> None:
+    out.write(f"Name:         {meta.name(obj)}\n")
+    if meta.namespace(obj):
+        out.write(f"Namespace:    {meta.namespace(obj)}\n")
+    if meta.labels_of(obj):
+        out.write(f"Labels:       "
+                  f"{','.join(f'{k}={v}' for k, v in sorted(meta.labels_of(obj).items()))}\n")
+    out.write(f"UID:          {meta.uid(obj)}\n")
+    for section in ("spec", "status"):
+        if obj.get(section):
+            out.write(f"{section.capitalize()}:\n")
+            dumped = yaml.safe_dump(obj[section], sort_keys=False)
+            for line in dumped.splitlines():
+                out.write(f"  {line}\n")
+
+
+# --------------------------------------------------------------------------- #
+# command implementation
+# --------------------------------------------------------------------------- #
+
+
+class Kubectl:
+    def __init__(self, client: Client, out=sys.stdout, err=sys.stderr):
+        self.client = client
+        self.out = out
+        self.err = err
+        # discovery is static within one invocation: sweep once, reuse
+        # (kubectl's CachedDiscoveryClient)
+        self._discovery: Optional[List[tuple]] = None
+
+    def _rc(self, resource: str):
+        """Resolve short names through the server's discovery."""
+        rc = getattr(self.client, resource, None)
+        if rc is not None:
+            return rc
+        for group, version, r in self._discovered_resources():
+            if resource in ((r["name"],) + tuple(r.get("shortNames", []))) \
+                    or resource == r["kind"].lower() \
+                    or resource == r["name"].rstrip("s"):
+                return self.client.resource(group, version, r["name"],
+                                            r.get("namespaced", True))
+        raise errors.new_bad_request(
+            f'the server doesn\'t have a resource type "{resource}"')
+
+    def _group_versions(self):
+        yield "", "v1"
+        groups = self.client.transport.request("GET", "/apis", {}, None)
+        for g in groups.get("groups", []):
+            for v in g.get("versions", []):
+                yield g["name"], v["version"]
+
+    def _discovered_resources(self) -> List[tuple]:
+        """[(group, version, APIResource dict)] — one sweep per invocation."""
+        if self._discovery is None:
+            out = []
+            for group, version in self._group_versions():
+                rl = self.client.transport.request(
+                    "GET",
+                    f"/apis/{group}/{version}" if group else f"/api/{version}",
+                    {}, None)
+                for r in rl.get("resources", []):
+                    out.append((group, version, r))
+            self._discovery = out
+        return self._discovery
+
+    # -- verbs -------------------------------------------------------------- #
+
+    def get(self, resource: str, name: str = "", namespace: str = "default",
+            all_namespaces: bool = False, selector: str = "",
+            output: str = "") -> int:
+        rc = self._rc(resource)
+        if name:
+            obj = rc.get(name, namespace if rc.namespaced else "")
+            if output in ("", "wide"):
+                print_table(rc.resource, [obj], rc.namespaced, False, self.out)
+            else:
+                print_obj(obj, output, self.out)
+            return 0
+        ns = "" if (all_namespaces or not rc.namespaced) else namespace
+        lst = rc.list(ns, label_selector=selector)
+        items = lst.get("items", [])
+        if output == "json":
+            print_obj(lst, "json", self.out)
+        elif output == "yaml":
+            print_obj(lst, "yaml", self.out)
+        elif output == "name":
+            for o in items:
+                print_obj(o, "name", self.out)
+        else:
+            print_table(rc.resource, items, rc.namespaced, all_namespaces,
+                        self.out)
+        return 0
+
+    def describe_cmd(self, resource: str, name: str,
+                     namespace: str = "default") -> int:
+        rc = self._rc(resource)
+        describe(rc.get(name, namespace if rc.namespaced else ""), self.out)
+        return 0
+
+    def _load_manifests(self, path: str) -> List[Obj]:
+        if path == "-":
+            docs = list(yaml.safe_load_all(sys.stdin.read()))
+        else:
+            with open(path) as f:
+                docs = list(yaml.safe_load_all(f.read()))
+        return [d for d in docs if d]
+
+    def _rc_for_obj(self, obj: Obj):
+        group, version, kind = meta.gvk(obj)
+        for g, v, r in self._discovered_resources():
+            if g == group and r["kind"] == kind and "/" not in r["name"]:
+                return self.client.resource(g, v, r["name"],
+                                            r.get("namespaced", True))
+        raise errors.new_bad_request(f"no resource mapping for kind {kind!r}")
+
+    def create(self, filename: str, namespace: str = "default") -> int:
+        for obj in self._load_manifests(filename):
+            rc = self._rc_for_obj(obj)
+            out = rc.create(obj, namespace if rc.namespaced else "")
+            self.out.write(f"{out.get('kind', '').lower()}/"
+                           f"{meta.name(out)} created\n")
+        return 0
+
+    def apply(self, filename: str, namespace: str = "default") -> int:
+        """Create-or-patch (the essential server-side apply semantics)."""
+        for obj in self._load_manifests(filename):
+            rc = self._rc_for_obj(obj)
+            ns = meta.namespace(obj) or namespace
+            try:
+                rc.get(meta.name(obj), ns if rc.namespaced else "")
+                rc.patch(meta.name(obj),
+                         {k: v for k, v in obj.items() if k != "status"},
+                         ns if rc.namespaced else "")
+                self.out.write(f"{obj.get('kind', '').lower()}/"
+                               f"{meta.name(obj)} configured\n")
+            except errors.StatusError as e:
+                if not errors.is_not_found(e):
+                    raise
+                rc.create(obj, ns if rc.namespaced else "")
+                self.out.write(f"{obj.get('kind', '').lower()}/"
+                               f"{meta.name(obj)} created\n")
+        return 0
+
+    def delete(self, resource: str, name: str,
+               namespace: str = "default") -> int:
+        rc = self._rc(resource)
+        rc.delete(name, namespace if rc.namespaced else "")
+        self.out.write(f"{rc.resource.rstrip('s')} \"{name}\" deleted\n")
+        return 0
+
+    def scale(self, resource: str, name: str, replicas: int,
+              namespace: str = "default") -> int:
+        rc = self._rc(resource)
+        rc.put_scale(name, replicas, namespace)
+        self.out.write(f"{rc.resource.rstrip('s')}/{name} scaled\n")
+        return 0
+
+    def cordon(self, node: str, on: bool = True) -> int:
+        self.client.nodes.patch(node, {"spec": {"unschedulable": on or None}},
+                                namespace="")
+        self.out.write(f"node/{node} {'cordoned' if on else 'uncordoned'}\n")
+        return 0
+
+    def drain(self, node: str) -> int:
+        self.cordon(node, True)
+        pods = self.client.pods.list(
+            "", field_selector=f"spec.nodeName={node}")["items"]
+        for p in pods:
+            ref = meta.controller_ref(p)
+            if ref and ref.get("kind") == "DaemonSet":
+                continue  # kubectl drain --ignore-daemonsets default
+            try:
+                self.client.pods.evict(meta.name(p), meta.namespace(p))
+                self.out.write(f"pod/{meta.name(p)} evicted\n")
+            except errors.StatusError as e:
+                self.err.write(f"error evicting pod {meta.name(p)}: "
+                               f"{e.message}\n")
+        self.out.write(f"node/{node} drained\n")
+        return 0
+
+    def label(self, resource: str, name: str, kv: List[str],
+              namespace: str = "default") -> int:
+        rc = self._rc(resource)
+        patch: Dict[str, Any] = {}
+        for pair in kv:
+            if pair.endswith("-"):
+                patch[pair[:-1]] = None
+            else:
+                k, _, v = pair.partition("=")
+                patch[k] = v
+        rc.patch(name, {"metadata": {"labels": patch}},
+                 namespace if rc.namespaced else "")
+        self.out.write(f"{rc.resource.rstrip('s')}/{name} labeled\n")
+        return 0
+
+    def taint(self, node: str, spec: str) -> int:
+        """kubectl taint nodes n1 key=value:NoSchedule (or key:NoSchedule-)."""
+        cur = self.client.nodes.get(node, "")
+        taints = [t for t in cur.get("spec", {}).get("taints", []) or []]
+        if spec.endswith("-"):
+            body = spec[:-1]
+            key = body.split("=")[0].split(":")[0]
+            taints = [t for t in taints if t.get("key") != key]
+            action = "untainted"
+        else:
+            kv, _, effect = spec.rpartition(":")
+            key, _, value = kv.partition("=")
+            taints = [t for t in taints if t.get("key") != key]
+            taints.append({"key": key, "value": value, "effect": effect})
+            action = "tainted"
+        cur.setdefault("spec", {})["taints"] = taints
+        self.client.nodes.update(cur, "")
+        self.out.write(f"node/{node} {action}\n")
+        return 0
+
+    def api_resources(self) -> int:
+        self.out.write("NAME  SHORTNAMES  APIGROUP  NAMESPACED  KIND\n")
+        for group, _, r in self._discovered_resources():
+            if "/" in r["name"]:
+                continue
+            self.out.write(
+                f"{r['name']}  {','.join(r.get('shortNames', []))}  "
+                f"{group}  {r.get('namespaced', True)}  {r['kind']}\n")
+        return 0
+
+    def version(self) -> int:
+        v = self.client.version()
+        self.out.write(f"Server Version: {v.get('gitVersion', '?')}\n")
+        return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kubectl",
+                                description="kubernetes-tpu CLI")
+    p.add_argument("-s", "--server", default="http://127.0.0.1:6443")
+    p.add_argument("-n", "--namespace", default="default")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("resource")
+    g.add_argument("name", nargs="?", default="")
+    g.add_argument("-A", "--all-namespaces", action="store_true")
+    g.add_argument("-l", "--selector", default="")
+    g.add_argument("-o", "--output", default="",
+                   choices=["", "json", "yaml", "name", "wide"])
+
+    d = sub.add_parser("describe")
+    d.add_argument("resource")
+    d.add_argument("name")
+
+    for verb in ("create", "apply"):
+        c = sub.add_parser(verb)
+        c.add_argument("-f", "--filename", required=True)
+
+    de = sub.add_parser("delete")
+    de.add_argument("resource")
+    de.add_argument("name")
+
+    sc = sub.add_parser("scale")
+    sc.add_argument("resource_slash_name")
+    sc.add_argument("--replicas", type=int, required=True)
+
+    for verb in ("cordon", "uncordon", "drain"):
+        cn = sub.add_parser(verb)
+        cn.add_argument("node")
+
+    lb = sub.add_parser("label")
+    lb.add_argument("resource")
+    lb.add_argument("name")
+    lb.add_argument("kv", nargs="+")
+
+    tn = sub.add_parser("taint")
+    tn.add_argument("nodes_literal")  # "nodes"
+    tn.add_argument("node")
+    tn.add_argument("spec")
+
+    sub.add_parser("api-resources")
+    sub.add_parser("version")
+    return p
+
+
+def main(argv: Optional[List[str]] = None, client: Optional[Client] = None,
+         out=sys.stdout, err=sys.stderr) -> int:
+    args = build_parser().parse_args(argv)
+    cl = client or Client.http(args.server)
+    k = Kubectl(cl, out=out, err=err)
+    try:
+        if args.verb == "get":
+            return k.get(args.resource, args.name, args.namespace,
+                         args.all_namespaces, args.selector, args.output)
+        if args.verb == "describe":
+            return k.describe_cmd(args.resource, args.name, args.namespace)
+        if args.verb == "create":
+            return k.create(args.filename, args.namespace)
+        if args.verb == "apply":
+            return k.apply(args.filename, args.namespace)
+        if args.verb == "delete":
+            return k.delete(args.resource, args.name, args.namespace)
+        if args.verb == "scale":
+            res, _, name = args.resource_slash_name.partition("/")
+            return k.scale(res, name, args.replicas, args.namespace)
+        if args.verb == "cordon":
+            return k.cordon(args.node, True)
+        if args.verb == "uncordon":
+            return k.cordon(args.node, False)
+        if args.verb == "drain":
+            return k.drain(args.node)
+        if args.verb == "label":
+            return k.label(args.resource, args.name, args.kv, args.namespace)
+        if args.verb == "taint":
+            return k.taint(args.node, args.spec)
+        if args.verb == "api-resources":
+            return k.api_resources()
+        if args.verb == "version":
+            return k.version()
+    except errors.StatusError as e:
+        err.write(f"Error from server ({e.reason}): {e.message}\n")
+        return 1
+    return 0

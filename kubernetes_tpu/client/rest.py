@@ -48,9 +48,11 @@ class LocalTransport:
 class HTTPTransport:
     """The wire path: JSON REST + line-delimited chunked watch streams."""
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 token: str = ""):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token
 
     def _url(self, path: str, query: Dict[str, str]) -> str:
         url = self.base_url + path
@@ -62,6 +64,8 @@ class HTTPTransport:
                 body: Optional[Obj]) -> Obj:
         req = urllib.request.Request(self._url(path, query), method=method)
         data = None
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
         if body is not None:
             data = json.dumps(body).encode()
             req.add_header("Content-Type", "application/json")
@@ -89,6 +93,8 @@ class HTTPTransport:
         def pump() -> None:
             try:
                 req = urllib.request.Request(self._url(path, q))
+                if self.token:
+                    req.add_header("Authorization", f"Bearer {self.token}")
                 with urllib.request.urlopen(req, timeout=self.timeout + 3600) as r:
                     for raw_line in r:
                         if w.stopped:
@@ -270,8 +276,8 @@ class Client:
         return Client(LocalTransport(api))
 
     @staticmethod
-    def http(base_url: str) -> "Client":
-        return Client(HTTPTransport(base_url))
+    def http(base_url: str, token: str = "") -> "Client":
+        return Client(HTTPTransport(base_url, token=token))
 
     def resource(self, group: str, version: str, resource: str,
                  namespaced: bool = True) -> ResourceClient:

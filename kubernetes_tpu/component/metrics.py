@@ -1,0 +1,170 @@
+"""Metrics: Prometheus-style registry with text exposition.
+
+Analog of `staging/src/k8s.io/component-base/metrics` (the Prometheus
+client wrapper every binary shares): Counter/Gauge/Histogram vectors with
+label sets, a process-wide default registry, and the text format served at
+/metrics (`pkg/scheduler/metrics/metrics.go` registers into exactly this).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._mu = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        return tuple(labels.get(n, "") for n in self.label_names)
+
+    @staticmethod
+    def _fmt_labels(names: Sequence[str], values: Sequence[str],
+                    extra: str = "") -> str:
+        pairs = [f'{n}="{v}"' for n, v in zip(names, values)]
+        if extra:
+            pairs.append(extra)
+        return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self._mu:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._mu:
+            return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        with self._mu:
+            out = [f"# HELP {self.name} {self.help}",
+                   f"# TYPE {self.name} {self.TYPE}"]
+            for k, v in sorted(self._values.items()):
+                out.append(f"{self.name}"
+                           f"{self._fmt_labels(self.label_names, k)} {v}")
+            if not self._values and not self.label_names:
+                # scalar metrics expose 0 before first touch; labeled vectors
+                # must NOT emit a bogus unlabeled series
+                out.append(f"{self.name} 0")
+            return out
+
+
+class Gauge(Counter):
+    TYPE = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._mu:
+            self._values[self._key(labels)] = value
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+
+class Histogram(_Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name, help_, label_names=(),
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        with self._mu:
+            k = self._key(labels)
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def count(self, **labels) -> int:
+        with self._mu:
+            return self._totals.get(self._key(labels), 0)
+
+    def quantile(self, q: float, **labels) -> float:
+        """Approximate quantile from bucket boundaries (for tests/SLO checks;
+        Prometheus computes this server-side with histogram_quantile)."""
+        with self._mu:
+            k = self._key(labels)
+            total = self._totals.get(k, 0)
+            if not total:
+                return 0.0
+            target = q * total
+            acc = 0
+            for i, b in enumerate(self.buckets):
+                acc = self._counts[k][i]
+                if acc >= target:
+                    return b
+            return float("inf")
+
+    def expose(self) -> List[str]:
+        with self._mu:
+            out = [f"# HELP {self.name} {self.help}",
+                   f"# TYPE {self.name} {self.TYPE}"]
+            for k in sorted(self._totals):
+                for i, b in enumerate(self.buckets):
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{self._fmt_labels(self.label_names, k, f'le=\"{b}\"')}"
+                        f" {self._counts[k][i]}")
+                out.append(f"{self.name}_bucket"
+                           f"{self._fmt_labels(self.label_names, k, 'le=\"+Inf\"')}"
+                           f" {self._totals[k]}")
+                out.append(f"{self.name}_sum"
+                           f"{self._fmt_labels(self.label_names, k)}"
+                           f" {self._sums[k]}")
+                out.append(f"{self.name}_count"
+                           f"{self._fmt_labels(self.label_names, k)}"
+                           f" {self._totals[k]}")
+            return out
+
+
+class Registry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._mu:
+            # idempotent by name (MustRegister panics; we return the existing
+            # collector so module reloads in tests stay cheap)
+            return self._metrics.setdefault(metric.name, metric)
+
+    def counter(self, name, help_="", labels=()) -> Counter:
+        return self.register(Counter(name, help_, labels))  # type: ignore
+
+    def gauge(self, name, help_="", labels=()) -> Gauge:
+        return self.register(Gauge(name, help_, labels))  # type: ignore
+
+    def histogram(self, name, help_="", labels=(),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_, labels, buckets))  # type: ignore
+
+    def expose_text(self) -> str:
+        with self._mu:
+            lines: List[str] = []
+            for m in self._metrics.values():
+                lines.extend(m.expose())
+            return "\n".join(lines) + "\n"
+
+
+DEFAULT_REGISTRY = Registry()

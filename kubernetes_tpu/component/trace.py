@@ -1,0 +1,69 @@
+"""Operation tracing: spans with steps + slow-op logging.
+
+Analog of `vendor/k8s.io/utils/trace/trace.go` (utiltrace) as used by the
+scheduler (`core/generic_scheduler.go:188-217` Step/LogIfLong): a Trace
+collects timed steps; if the whole operation exceeds a threshold, the steps
+are emitted so slow cycles are explainable. Also the hook point for JAX
+profiler ranges on device-dispatch steps.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional, Tuple
+
+logger = logging.getLogger("kubernetes_tpu.trace")
+
+
+class Trace:
+    def __init__(self, name: str, clock: Callable[[], float] = time.monotonic,
+                 **fields):
+        self.name = name
+        self.fields = fields
+        self.clock = clock
+        self.start = clock()
+        self.steps: List[Tuple[float, str]] = []
+        self._ended: Optional[float] = None
+
+    def step(self, msg: str) -> None:
+        self.steps.append((self.clock(), msg))
+
+    def duration(self) -> float:
+        return (self._ended or self.clock()) - self.start
+
+    def log_if_long(self, threshold: float,
+                    sink: Optional[Callable[[str], None]] = None) -> bool:
+        """utiltrace.LogIfLong: emit the step timeline when total > threshold.
+        Returns True if it logged."""
+        self._ended = self.clock()
+        total = self.duration()
+        if total < threshold:
+            return False
+        emit = sink or (lambda s: logger.warning("%s", s))
+        fs = ",".join(f"{k}={v}" for k, v in self.fields.items())
+        lines = [f'Trace "{self.name}" ({fs}) took {total * 1000:.1f}ms '
+                 f"(threshold {threshold * 1000:.0f}ms):"]
+        prev = self.start
+        for ts, msg in self.steps:
+            lines.append(f"  +{(ts - prev) * 1000:.1f}ms {msg}")
+            prev = ts
+        emit("\n".join(lines))
+        return True
+
+    def __enter__(self) -> "Trace":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.log_if_long(0.1)
+
+
+def device_step_marker(name: str):
+    """JAX profiler named scope for device-dispatch steps — shows up in TPU
+    profiler timelines (the jax.profiler analog of the reference's pprof)."""
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 — profiling must never break the op
+        import contextlib
+        return contextlib.nullcontext()

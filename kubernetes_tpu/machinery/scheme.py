@@ -64,6 +64,14 @@ class Scheme:
             self._by_short[s] = info
         return info
 
+    def unregister(self, group: str, resource: str) -> None:
+        info = self._by_resource.pop((group, resource), None)
+        if info is not None:
+            self._by_gvk.pop((info.group, info.version, info.kind), None)
+            for s in info.short_names:
+                if self._by_short.get(s) is info:
+                    del self._by_short[s]
+
     def resources(self) -> List[ResourceInfo]:
         return list(self._by_resource.values())
 

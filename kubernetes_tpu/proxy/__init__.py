@@ -1,0 +1,8 @@
+"""Service dataplane: the kube-proxy analog.
+
+TPU-native analog of SURVEY.md layer 9 (`pkg/proxy`, `cmd/kube-proxy`).
+"""
+
+from kubernetes_tpu.proxy.proxier import Proxier, RuleTable
+
+__all__ = ["Proxier", "RuleTable"]

@@ -1,0 +1,203 @@
+"""Proxier: Services × Endpoints → a service VIP rule table.
+
+Analog of `pkg/proxy/iptables/proxier.go:251` reduced to its essential
+computation: track Service/Endpoints changes (the serviceChanges/
+endpointsChanges trackers), and on each syncProxyRules pass rebuild only
+what changed into a routing table mapping (clusterIP, port) → backend
+endpoints with round-robin selection and sessionAffinity ClientIP pinning.
+The kernel-programming half (iptables-restore writes) is environment
+plumbing, not semantics; `RuleTable.render_iptables()` emits the equivalent
+restore input for inspection/tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.client.informers import InformerFactory
+from kubernetes_tpu.machinery import meta
+
+Obj = dict
+
+ServicePortKey = Tuple[str, str, str]  # (namespace, service, port name)
+
+
+@dataclass
+class ServicePortRules:
+    cluster_ip: str
+    port: int
+    protocol: str
+    node_port: int = 0
+    session_affinity: str = "None"
+    affinity_timeout: int = 10800
+    endpoints: List[str] = field(default_factory=list)  # "ip:port"
+    _rr: int = 0
+    _affinity: Dict[str, Tuple[str, float]] = field(default_factory=dict)
+
+    def pick(self, client_ip: str = "", now: Optional[float] = None) -> Optional[str]:
+        """One balancing decision (round robin; ClientIP affinity pins)."""
+        if not self.endpoints:
+            return None
+        now = time.monotonic() if now is None else now
+        if self.session_affinity == "ClientIP" and client_ip:
+            pinned = self._affinity.get(client_ip)
+            if pinned and pinned[0] in self.endpoints and \
+                    now - pinned[1] < self.affinity_timeout:
+                self._affinity[client_ip] = (pinned[0], now)
+                return pinned[0]
+        choice = self.endpoints[self._rr % len(self.endpoints)]
+        self._rr += 1
+        if self.session_affinity == "ClientIP" and client_ip:
+            self._affinity[client_ip] = (choice, now)
+        return choice
+
+
+class RuleTable:
+    """The programmed dataplane state."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.by_port: Dict[ServicePortKey, ServicePortRules] = {}
+        self.by_vip: Dict[Tuple[str, int], ServicePortKey] = {}
+        self.sync_count = 0
+
+    def replace_service(self, key_ns: str, key_name: str,
+                        rules: Dict[str, ServicePortRules]) -> None:
+        with self._mu:
+            # drop this service's old ports, install the new set
+            for (ns, name, pname) in [k for k in self.by_port
+                                      if k[0] == key_ns and k[1] == key_name]:
+                old = self.by_port.pop((ns, name, pname))
+                self.by_vip.pop((old.cluster_ip, old.port), None)
+            for pname, r in rules.items():
+                self.by_port[(key_ns, key_name, pname)] = r
+                if r.cluster_ip:
+                    self.by_vip[(r.cluster_ip, r.port)] = (key_ns, key_name,
+                                                           pname)
+            self.sync_count += 1
+
+    def drop_service(self, ns: str, name: str) -> None:
+        self.replace_service(ns, name, {})
+
+    def lookup(self, vip: str, port: int,
+               client_ip: str = "") -> Optional[str]:
+        """Route one connection: VIP:port → endpoint ip:port."""
+        with self._mu:
+            key = self.by_vip.get((vip, port))
+            if key is None:
+                return None
+            return self.by_port[key].pick(client_ip)
+
+    def render_iptables(self) -> str:
+        """The iptables-restore document the reference writes
+        (proxier.go syncProxyRules chain layout, abbreviated)."""
+        with self._mu:
+            lines = ["*nat", ":KUBE-SERVICES - [0:0]"]
+            for (ns, name, pname), r in sorted(self.by_port.items()):
+                svc_chain = f"KUBE-SVC-{ns}-{name}-{pname}".upper()[:28]
+                lines.append(
+                    f"-A KUBE-SERVICES -d {r.cluster_ip}/32 -p "
+                    f"{r.protocol.lower()} --dport {r.port} -j {svc_chain}")
+                n = len(r.endpoints)
+                for i, ep in enumerate(r.endpoints):
+                    sep_chain = f"KUBE-SEP-{ns}-{name}-{pname}-{i}".upper()[:28]
+                    if i < n - 1:
+                        lines.append(
+                            f"-A {svc_chain} -m statistic --mode random "
+                            f"--probability {1.0 / (n - i):.5f} -j {sep_chain}")
+                    else:
+                        lines.append(f"-A {svc_chain} -j {sep_chain}")
+                    lines.append(f"-A {sep_chain} -p {r.protocol.lower()} "
+                                 f"-m {r.protocol.lower()} -j DNAT "
+                                 f"--to-destination {ep}")
+            lines.append("COMMIT")
+            return "\n".join(lines)
+
+
+class Proxier:
+    """Watch-driven sync loop over Services + Endpoints."""
+
+    def __init__(self, client, factory: Optional[InformerFactory] = None,
+                 cluster_ip_prefix: str = "10.96"):
+        self.client = client
+        self.factory = factory or InformerFactory(client)
+        self.table = RuleTable()
+        self._ip_seq = 0
+        self._ip_by_svc: Dict[str, str] = {}
+        self.cluster_ip_prefix = cluster_ip_prefix
+        self._pending: set = set()
+        self._pending_mu = threading.Lock()
+        self.svc_informer = self.factory.informer("services")
+        self.ep_informer = self.factory.informer("endpoints")
+        for inf in (self.svc_informer, self.ep_informer):
+            inf.add_handlers(on_add=self._changed,
+                             on_update=lambda o, n: self._changed(n),
+                             on_delete=self._changed)
+
+    def _changed(self, obj: Obj) -> None:
+        with self._pending_mu:
+            self._pending.add(meta.namespaced_key(obj))
+
+    def _cluster_ip(self, svc: Obj) -> str:
+        """Allocate/remember a ClusterIP (the apiserver's allocator role)."""
+        explicit = svc.get("spec", {}).get("clusterIP", "")
+        if explicit and explicit != "None":
+            return explicit
+        if explicit == "None":
+            return ""  # headless
+        key = meta.namespaced_key(svc)
+        if key not in self._ip_by_svc:
+            self._ip_seq += 1
+            self._ip_by_svc[key] = (f"{self.cluster_ip_prefix}."
+                                    f"{(self._ip_seq >> 8) & 255}."
+                                    f"{self._ip_seq & 255}")
+        return self._ip_by_svc[key]
+
+    def sync(self) -> int:
+        """One syncProxyRules pass over changed services. Returns the number
+        of services reprogrammed."""
+        with self._pending_mu:
+            pending, self._pending = self._pending, set()
+        n = 0
+        for key in pending:
+            ns, name = meta.split_key(key)
+            svc = self.svc_informer.lister.get(ns, name)
+            if svc is None:
+                self.table.drop_service(ns, name)
+                n += 1
+                continue
+            ep = self.ep_informer.lister.get(ns, name)
+            subsets = (ep or {}).get("subsets") or []
+            rules: Dict[str, ServicePortRules] = {}
+            cluster_ip = self._cluster_ip(svc)
+            for p in svc.get("spec", {}).get("ports", []) or []:
+                pname = p.get("name", "")
+                backends: List[str] = []
+                for ss in subsets:
+                    eps_port = next(
+                        (int(sp.get("port", 0)) for sp in ss.get("ports", [])
+                         if sp.get("name", "") == pname),
+                        int(p.get("targetPort", p.get("port", 0))
+                            if not isinstance(p.get("targetPort"), str)
+                            else p.get("port", 0)))
+                    for addr in ss.get("addresses", []) or []:
+                        backends.append(f"{addr['ip']}:{eps_port}")
+                rules[pname] = ServicePortRules(
+                    cluster_ip=cluster_ip,
+                    port=int(p.get("port", 0)),
+                    protocol=p.get("protocol", "TCP"),
+                    node_port=int(p.get("nodePort", 0) or 0),
+                    session_affinity=svc.get("spec", {})
+                    .get("sessionAffinity", "None"),
+                    endpoints=backends)
+            self.table.replace_service(ns, name, rules)
+            n += 1
+        return n
+
+    def sync_all(self) -> int:
+        for svc in self.svc_informer.lister.list():
+            self._changed(svc)
+        return self.sync()

@@ -1,0 +1,56 @@
+"""Scheduler metrics (pkg/scheduler/metrics/metrics.go:29-99).
+
+Same metric names as the reference so dashboards port over:
+scheduling_duration_seconds / e2e_scheduling_duration_seconds histograms,
+attempt counters by result, queue depth and cache size gauges
+(cache.go:692-696, scheduling_queue.go:237-243), preemption counters.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.component.metrics import DEFAULT_REGISTRY as REG
+
+SCHEDULING_DURATION = REG.histogram(
+    "scheduler_scheduling_duration_seconds",
+    "Scheduling cycle latency (one batched wave)", labels=("operation",))
+E2E_SCHEDULING_DURATION = REG.histogram(
+    "scheduler_e2e_scheduling_duration_seconds",
+    "End-to-end scheduling latency per wave")
+BINDING_DURATION = REG.histogram(
+    "scheduler_binding_duration_seconds", "Binding latency")
+POD_SCHEDULE_ATTEMPTS = REG.counter(
+    "scheduler_pod_scheduling_attempts_total",
+    "Pods attempted, by result", labels=("result",))
+PENDING_PODS = REG.gauge(
+    "scheduler_pending_pods", "Pending pods by queue",
+    labels=("queue",))
+CACHE_SIZE = REG.gauge(
+    "scheduler_cache_size", "Scheduler cache objects", labels=("type",))
+PREEMPTION_VICTIMS = REG.counter(
+    "scheduler_pod_preemption_victims_total", "Preemption victims")
+PREEMPTION_ATTEMPTS = REG.counter(
+    "scheduler_total_preemption_attempts_total", "Preemption attempts")
+WAVE_SIZE = REG.histogram(
+    "scheduler_wave_batch_size", "Pods per batched device wave",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192))
+
+
+def observe_wave(stats, queue_lengths, cache_counts) -> None:
+    """Record one wave's outcome (called from the scheduler server loop)."""
+    if stats.attempted:
+        SCHEDULING_DURATION.observe(stats.cycle_seconds, operation="wave")
+        E2E_SCHEDULING_DURATION.observe(stats.cycle_seconds)
+        WAVE_SIZE.observe(stats.attempted)
+    if stats.scheduled:
+        POD_SCHEDULE_ATTEMPTS.inc(stats.scheduled, result="scheduled")
+    if stats.unschedulable:
+        POD_SCHEDULE_ATTEMPTS.inc(stats.unschedulable, result="unschedulable")
+    if stats.bind_errors:
+        POD_SCHEDULE_ATTEMPTS.inc(stats.bind_errors, result="error")
+    active, backoff, unsched = queue_lengths
+    PENDING_PODS.set(active, queue="active")
+    PENDING_PODS.set(backoff, queue="backoff")
+    PENDING_PODS.set(unsched, queue="unschedulable")
+    nodes, pods = cache_counts
+    CACHE_SIZE.set(nodes, type="nodes")
+    CACHE_SIZE.set(pods, type="pods")

@@ -174,11 +174,17 @@ class SchedulerServer:
                 self._stop.wait(self.cycle_interval)
 
     def run_one_wave(self):
+        from kubernetes_tpu.sched import metrics as sched_metrics
+
         with self._mu:
             try:
                 stats = self.scheduler.schedule_pending()
             except Exception:  # noqa: BLE001 — the loop never dies
                 return None
+            queue_lengths = self.scheduler.queue.lengths()
+            cache_counts = (len(self.scheduler.cache.nodes()),
+                            len(self.scheduler.cache.scheduled_pods()))
+        sched_metrics.observe_wave(stats, queue_lengths, cache_counts)
         self.total_scheduled += stats.scheduled
         if stats.unschedulable:
             self.total_unschedulable_events += stats.unschedulable

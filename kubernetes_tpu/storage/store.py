@@ -44,7 +44,9 @@ class Storage:
     def __init__(self, kv=None):
         self.kv = kv if kv is not None else native.new_kv()
         self._watch_mu = threading.Lock()
-        self._watchers: List[Tuple[str, mwatch.Watch, Predicate]] = []
+        # (prefix, watch, predicate, since_rev): events <= since_rev are
+        # before this watcher's horizon and never delivered to it
+        self._watchers: List[Tuple[str, mwatch.Watch, Predicate, int]] = []
         self._dispatched_rev = self.kv.rev()
         self._stop = threading.Event()
         self._pump = threading.Thread(target=self._dispatch_loop,
@@ -55,7 +57,7 @@ class Storage:
         self._stop.set()
         self._pump.join(timeout=2)
         with self._watch_mu:
-            for _, w, _ in self._watchers:
+            for _, w, _, _ in self._watchers:
                 w.stop()
             self._watchers.clear()
         self.kv.close()
@@ -154,9 +156,12 @@ class Storage:
         """
         w = mwatch.Watch(capacity=8192)
         with self._watch_mu:
-            since = int(since_rv) if since_rv not in ("", "0") else self._dispatched_rev
+            # "" / "0" = from NOW: the current store revision, regardless of
+            # how far the dispatch pump has gotten
+            since = int(since_rv) if since_rv not in ("", "0") else self.kv.rev()
             # catch-up: replay history before going live under the same lock
-            # the pump uses, so no event is missed or duplicated
+            # the pump uses, so no event is missed or duplicated; the pump
+            # delivers everything > max(since, _dispatched_rev)
             try:
                 history = self.kv.events_since(since, prefix)
             except native.CompactedError:
@@ -167,7 +172,8 @@ class Storage:
                 if ev.rev > self._dispatched_rev:
                     break  # the pump will deliver the rest
                 self._send(w, ev, predicate)
-            self._watchers.append((prefix, w, predicate))
+            self._watchers.append((prefix, w, predicate,
+                                   max(since, self._dispatched_rev)))
         return w
 
     @staticmethod
@@ -198,7 +204,7 @@ class Storage:
                 with self._watch_mu:
                     gone = errors.new_gone(
                         "watch events compacted away; relist required")
-                    for _, w, _ in self._watchers:
+                    for _, w, _, _ in self._watchers:
                         w.send(mwatch.Event(mwatch.ERROR, gone.status()),
                                timeout=0)
                         w.stop()
@@ -207,12 +213,12 @@ class Storage:
                 continue
             with self._watch_mu:
                 live = []
-                for prefix, w, pred in self._watchers:
+                for prefix, w, pred, since in self._watchers:
                     if w.stopped:
                         continue
-                    live.append((prefix, w, pred))
+                    live.append((prefix, w, pred, since))
                     for ev in events:
-                        if ev.key.startswith(prefix):
+                        if ev.rev > since and ev.key.startswith(prefix):
                             self._send(w, ev, pred)
                 self._watchers = live
                 if events:

@@ -1,0 +1,333 @@
+"""Admission chain, RBAC authorization, CRD registration.
+
+Mirrors plugin/pkg/admission/*/admission_test.go, RBAC authorizer tests,
+and apiextensions integration coverage.
+"""
+
+import pytest
+
+from kubernetes_tpu.apiserver import (
+    APIServer,
+    AuthGate,
+    HTTPGateway,
+    RBACAuthorizer,
+    TokenAuthenticator,
+)
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.machinery import errors
+
+
+@pytest.fixture
+def api():
+    a = APIServer()
+    yield a
+    a.close()
+
+
+def mkpod(name, ns="default", **kw):
+    p = {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": name, "namespace": ns},
+         "spec": {"containers": [{"name": "c", "image": "img"}]}}
+    p["spec"].update(kw)
+    return p
+
+
+class TestAdmission:
+    def test_namespace_lifecycle_blocks_creates(self, api):
+        pods = api.store("", "pods")
+        with pytest.raises(errors.StatusError) as ei:
+            pods.create("ghost-ns", mkpod("a", ns="ghost-ns"))
+        assert errors.is_forbidden(ei.value)
+        # terminating namespace blocks too
+        api.store("", "namespaces").create("", {
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "dying"}})
+        api.delete_namespace("dying")
+        with pytest.raises(errors.StatusError):
+            pods.create("dying", mkpod("a", ns="dying"))
+        # protected namespaces cannot be deleted
+        with pytest.raises(errors.StatusError):
+            api.delete_namespace("kube-system")
+
+    def test_default_tolerations_added(self, api):
+        out = api.store("", "pods").create("default", mkpod("t"))
+        keys = {t["key"] for t in out["spec"]["tolerations"]}
+        assert "node.kubernetes.io/not-ready" in keys
+        assert "node.kubernetes.io/unreachable" in keys
+        assert all(t.get("tolerationSeconds") == 300
+                   for t in out["spec"]["tolerations"])
+
+    def test_priority_class_resolution(self, api):
+        api.store("scheduling.k8s.io", "priorityclasses").create("", {
+            "apiVersion": "scheduling.k8s.io/v1", "kind": "PriorityClass",
+            "metadata": {"name": "high"}, "value": 1000})
+        out = api.store("", "pods").create(
+            "default", mkpod("p", priorityClassName="high"))
+        assert out["spec"]["priority"] == 1000
+        out2 = api.store("", "pods").create(
+            "default", mkpod("crit", priorityClassName="system-cluster-critical"))
+        assert out2["spec"]["priority"] == 2000000000
+        with pytest.raises(errors.StatusError) as ei:
+            api.store("", "pods").create(
+                "default", mkpod("bad", priorityClassName="nope"))
+        assert errors.is_forbidden(ei.value)
+
+    def test_limit_ranger_defaults_and_max(self, api):
+        api.store("", "limitranges").create("default", {
+            "apiVersion": "v1", "kind": "LimitRange",
+            "metadata": {"name": "lr", "namespace": "default"},
+            "spec": {"limits": [{"type": "Container",
+                                 "defaultRequest": {"cpu": "100m"},
+                                 "max": {"cpu": "2"}}]}})
+        out = api.store("", "pods").create("default", mkpod("lrp"))
+        assert out["spec"]["containers"][0]["resources"]["requests"]["cpu"] \
+            == "100m"
+        big = mkpod("big")
+        big["spec"]["containers"][0]["resources"] = {"requests": {"cpu": "4"}}
+        with pytest.raises(errors.StatusError) as ei:
+            api.store("", "pods").create("default", big)
+        assert errors.is_forbidden(ei.value)
+
+    def test_resource_quota_enforced(self, api):
+        api.store("", "resourcequotas").create("default", {
+            "apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": {"name": "q", "namespace": "default"},
+            "spec": {"hard": {"pods": "2", "requests.cpu": "1"}}})
+        pods = api.store("", "pods")
+        p1 = mkpod("q1")
+        p1["spec"]["containers"][0]["resources"] = {"requests": {"cpu": "600m"}}
+        pods.create("default", p1)
+        # cpu quota: second 600m pod exceeds 1 cpu
+        p2 = mkpod("q2")
+        p2["spec"]["containers"][0]["resources"] = {"requests": {"cpu": "600m"}}
+        with pytest.raises(errors.StatusError) as ei:
+            pods.create("default", p2)
+        assert "exceeded quota" in ei.value.message
+        # pod-count quota
+        pods.create("default", mkpod("q3"))
+        with pytest.raises(errors.StatusError):
+            pods.create("default", mkpod("q4"))
+
+    def test_eviction_respects_pdb(self, api):
+        api.store("policy", "poddisruptionbudgets").create("default", {
+            "apiVersion": "policy/v1beta1", "kind": "PodDisruptionBudget",
+            "metadata": {"name": "pdb", "namespace": "default"},
+            "spec": {"minAvailable": 1,
+                     "selector": {"matchLabels": {"app": "g"}}}})
+        pod = mkpod("g1")
+        pod["metadata"]["labels"] = {"app": "g"}
+        api.store("", "pods").create("default", pod)
+        # pdb status says 0 disruptions allowed (disruption controller not
+        # running; default status is empty → 0)
+        with pytest.raises(errors.StatusError) as ei:
+            api.evict_pod("default", "g1", {})
+        assert ei.value.code == 429
+        # raise the allowance → eviction passes and decrements
+        st = api.store("policy", "poddisruptionbudgets")
+        cur = st.get("default", "pdb")
+        cur["status"] = {"disruptionsAllowed": 1}
+        st.update("default", "pdb", cur, subresource="status")
+        api.evict_pod("default", "g1", {})
+        assert st.get("default", "pdb")["status"]["disruptionsAllowed"] == 0
+
+
+class TestRBAC:
+    def _setup_rbac(self, api, client):
+        g = "rbac.authorization.k8s.io"
+        client.resource(g, "v1", "clusterroles", False).create({
+            "apiVersion": f"{g}/v1", "kind": "ClusterRole",
+            "metadata": {"name": "pod-reader"},
+            "rules": [{"verbs": ["get", "list", "watch"],
+                       "apiGroups": [""], "resources": ["pods"]}]})
+        client.resource(g, "v1", "clusterrolebindings", False).create({
+            "apiVersion": f"{g}/v1", "kind": "ClusterRoleBinding",
+            "metadata": {"name": "read-pods"},
+            "subjects": [{"kind": "User", "name": "alice"}],
+            "roleRef": {"kind": "ClusterRole", "name": "pod-reader"}})
+        client.resource(g, "v1", "roles", True).create({
+            "apiVersion": f"{g}/v1", "kind": "Role",
+            "metadata": {"name": "writer", "namespace": "default"},
+            "rules": [{"verbs": ["*"], "apiGroups": [""],
+                       "resources": ["pods"]}]})
+        client.resource(g, "v1", "rolebindings", True).create({
+            "apiVersion": f"{g}/v1", "kind": "RoleBinding",
+            "metadata": {"name": "write-pods", "namespace": "default"},
+            "subjects": [{"kind": "Group", "name": "devs"}],
+            "roleRef": {"kind": "Role", "name": "writer"}})
+
+    def test_rbac_over_http(self, api):
+        authn = TokenAuthenticator()
+        authn.add("alice-token", "alice")
+        authn.add("bob-token", "bob", groups=("devs",))
+        admin = Client.local(api)
+        self._setup_rbac(api, admin)
+        gate = AuthGate(authn, RBACAuthorizer(api))
+        gw = HTTPGateway(api, auth_gate=gate).start()
+        try:
+            admin.pods.create(mkpod("secret-pod"))
+            alice = Client.http(gw.url, token="alice-token")
+            bob = Client.http(gw.url, token="bob-token")
+            anon = Client.http(gw.url)
+            # alice can read pods everywhere
+            assert alice.pods.get("secret-pod")["metadata"]["name"] == "secret-pod"
+            assert len(alice.pods.list("default")["items"]) == 1
+            # alice cannot create
+            with pytest.raises(errors.StatusError) as ei:
+                alice.pods.create(mkpod("nope"))
+            assert ei.value.code == 403
+            # bob (group devs) can create in default only
+            bob.pods.create(mkpod("bobs"))
+            with pytest.raises(errors.StatusError):
+                bob.nodes.list()
+            # anonymous is denied; bad token is 401
+            with pytest.raises(errors.StatusError) as ei:
+                anon.pods.list("default")
+            assert ei.value.code == 403
+            with pytest.raises(errors.StatusError) as ei:
+                Client.http(gw.url, token="wrong").pods.list("default")
+            assert ei.value.code == 401
+            # health endpoints stay open
+            import urllib.request
+            with urllib.request.urlopen(gw.url + "/healthz", timeout=5) as r:
+                assert r.status == 200
+        finally:
+            gw.stop()
+
+
+class TestCRD:
+    CRD = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "tpujobs.ml.example.com"},
+        "spec": {
+            "group": "ml.example.com",
+            "scope": "Namespaced",
+            "names": {"plural": "tpujobs", "kind": "TPUJob",
+                      "shortNames": ["tj"]},
+            "versions": [{
+                "name": "v1", "served": True, "storage": True,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "required": ["spec"],
+                    "properties": {"spec": {
+                        "type": "object",
+                        "required": ["replicas"],
+                        "properties": {
+                            "replicas": {"type": "integer", "minimum": 1},
+                            "topology": {"type": "string",
+                                         "enum": ["2x2", "4x4", "8x8"]},
+                        }}}}},
+                "subresources": {"status": {}},
+            }],
+        },
+    }
+
+    def test_crd_registers_and_validates(self, api):
+        client = Client.local(api)
+        crd_rc = client.customresourcedefinitions
+        out = crd_rc.create(self.CRD)
+        # Established condition set
+        got = crd_rc.get("tpujobs.ml.example.com", "")
+        assert any(c["type"] == "Established"
+                   for c in got["status"]["conditions"])
+        # the new resource serves CRUD + validation
+        tj = client.resource("ml.example.com", "v1", "tpujobs", True)
+        created = tj.create({
+            "apiVersion": "ml.example.com/v1", "kind": "TPUJob",
+            "metadata": {"name": "train", "namespace": "default"},
+            "spec": {"replicas": 4, "topology": "4x4"}})
+        assert created["metadata"]["uid"]
+        assert tj.get("train")["spec"]["replicas"] == 4
+        # schema violations reject
+        with pytest.raises(errors.StatusError) as ei:
+            tj.create({"apiVersion": "ml.example.com/v1", "kind": "TPUJob",
+                       "metadata": {"name": "bad", "namespace": "default"},
+                       "spec": {"replicas": 0}})
+        assert ei.value.code == 422
+        with pytest.raises(errors.StatusError):
+            tj.create({"apiVersion": "ml.example.com/v1", "kind": "TPUJob",
+                       "metadata": {"name": "bad2", "namespace": "default"},
+                       "spec": {"replicas": 1, "topology": "16x16"}})
+        with pytest.raises(errors.StatusError):
+            tj.create({"apiVersion": "ml.example.com/v1", "kind": "TPUJob",
+                       "metadata": {"name": "bad3", "namespace": "default"}})
+        # discovery lists the group
+        groups = api.discovery_groups()
+        assert any(g["name"] == "ml.example.com" for g in groups["groups"])
+        # watch works on CRs (full storage path)
+        w = tj.watch("default")
+        tj.create({"apiVersion": "ml.example.com/v1", "kind": "TPUJob",
+                   "metadata": {"name": "w1", "namespace": "default"},
+                   "spec": {"replicas": 2}})
+        ev = w.next(timeout=2)
+        assert ev is not None and ev.object["metadata"]["name"] == "w1"
+        w.stop()
+
+    def test_crd_survives_restart(self, api):
+        client = Client.local(api)
+        client.customresourcedefinitions.create(self.CRD)
+        # a new APIServer over the same storage re-registers served CRDs
+        api2 = APIServer(storage=api.storage)
+        try:
+            tj = Client.local(api2).resource("ml.example.com", "v1",
+                                             "tpujobs", True)
+            tj.create({"apiVersion": "ml.example.com/v1", "kind": "TPUJob",
+                       "metadata": {"name": "again", "namespace": "default"},
+                       "spec": {"replicas": 2}})
+            assert tj.get("again")["spec"]["replicas"] == 2
+        finally:
+            pass  # shared storage: api fixture closes it
+
+    def test_crd_update_and_delete_lifecycle(self, api):
+        """Schema updates take effect immediately; deletion unserves."""
+        client = Client.local(api)
+        client.customresourcedefinitions.create(self.CRD)
+        tj = client.resource("ml.example.com", "v1", "tpujobs", True)
+        tj.create({"apiVersion": "ml.example.com/v1", "kind": "TPUJob",
+                   "metadata": {"name": "ok", "namespace": "default"},
+                   "spec": {"replicas": 1}})
+        # raise the minimum to 2 via CRD update
+        crd = client.customresourcedefinitions.get("tpujobs.ml.example.com", "")
+        crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+            "properties"]["spec"]["properties"]["replicas"]["minimum"] = 2
+        client.customresourcedefinitions.update(crd, "")
+        with pytest.raises(errors.StatusError) as ei:
+            tj.create({"apiVersion": "ml.example.com/v1", "kind": "TPUJob",
+                       "metadata": {"name": "low", "namespace": "default"},
+                       "spec": {"replicas": 1}})
+        assert ei.value.code == 422
+        # deletion unserves the resource
+        client.customresourcedefinitions.delete("tpujobs.ml.example.com", "")
+        with pytest.raises(errors.StatusError) as ei:
+            tj.list("default")
+        assert errors.is_not_found(ei.value)
+
+
+class TestQuotaConcurrency:
+    def test_concurrent_creates_cannot_exceed_quota(self, api):
+        """Regression: the quota check+reserve is one atomic CAS, so N
+        racing creates admit at most `hard.pods`."""
+        import threading
+        api.store("", "resourcequotas").create("default", {
+            "apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": {"name": "q", "namespace": "default"},
+            "spec": {"hard": {"pods": "3"}}})
+        results = []
+
+        def create(i):
+            try:
+                api.store("", "pods").create("default", mkpod(f"r{i}"))
+                results.append(True)
+            except errors.StatusError:
+                results.append(False)
+
+        threads = [threading.Thread(target=create, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(results) == 3
+        pods, _ = api.store("", "pods").storage.list(
+            api.store("", "pods").prefix_for("default"))
+        assert len(pods) == 3

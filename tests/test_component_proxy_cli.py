@@ -1,0 +1,286 @@
+"""component-base (metrics/featuregates/trace), kube-proxy, kubectl, cluster.
+
+The shapes of component-base's metrics tests, pkg/proxy/iptables
+proxier_test.go, and kubectl cmd tests — against the real stack.
+"""
+
+import io
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer, HTTPGateway
+from kubernetes_tpu.cli import Cluster, ClusterConfig, Kubectl
+from kubernetes_tpu.cli.kubectl import main as kubectl_main
+from kubernetes_tpu.client import Client, InformerFactory
+from kubernetes_tpu.component import (
+    DEFAULT_FEATURE_GATES,
+    FeatureGate,
+    FeatureSpec,
+    Registry,
+    Trace,
+)
+from kubernetes_tpu.machinery import errors
+from kubernetes_tpu.proxy import Proxier
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_exposition(self):
+        reg = Registry()
+        c = reg.counter("requests_total", "requests", labels=("verb",))
+        c.inc(verb="GET")
+        c.inc(2, verb="GET")
+        c.inc(verb="POST")
+        assert c.value(verb="GET") == 3
+        g = reg.gauge("queue_depth", "depth")
+        g.set(7)
+        g.dec()
+        h = reg.histogram("latency_seconds", "lat", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        assert h.count() == 3
+        assert h.quantile(0.5) == 1.0
+        text = reg.expose_text()
+        assert '# TYPE requests_total counter' in text
+        assert 'requests_total{verb="GET"} 3.0' in text
+        assert "queue_depth 6.0" in text
+        assert 'latency_seconds_bucket{le="1.0"} 2' in text
+        assert "latency_seconds_count 3" in text
+
+    def test_registry_idempotent_by_name(self):
+        reg = Registry()
+        a = reg.counter("x", "x")
+        b = reg.counter("x", "x")
+        assert a is b
+
+
+class TestFeatureGates:
+    def test_defaults_parse_and_lock(self):
+        fg = FeatureGate({"A": FeatureSpec(default=False),
+                          "B": FeatureSpec(default=True),
+                          "GAFeat": FeatureSpec(default=True,
+                                                locked_to_default=True)})
+        assert not fg.enabled("A") and fg.enabled("B")
+        fg.parse("A=true,B=false")
+        assert fg.enabled("A") and not fg.enabled("B")
+        with pytest.raises(KeyError):
+            fg.enabled("nope")
+        with pytest.raises(ValueError):
+            fg.set("GAFeat", False)
+        assert DEFAULT_FEATURE_GATES.enabled("EvenPodsSpread")
+
+    def test_scheduler_metrics_flow_to_metrics_endpoint(self):
+        from kubernetes_tpu.sched.server import SchedulerServer
+
+        api = APIServer()
+        client = Client.local(api)
+        sched = SchedulerServer(client).start()
+        try:
+            client.nodes.create({
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": "m1"},
+                "status": {"capacity": {"cpu": "4", "memory": "8Gi",
+                                        "pods": "110"},
+                           "allocatable": {"cpu": "4", "memory": "8Gi",
+                                           "pods": "110"}}})
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "m", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "i"}]}})
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if client.pods.get("m")["spec"].get("nodeName"):
+                    break
+                time.sleep(0.1)
+            from kubernetes_tpu.apiserver.server import handle_rest
+            code, text = handle_rest(api, "GET", "/metrics", {}, None)
+            assert code == 200
+            assert "scheduler_e2e_scheduling_duration_seconds_count" in text
+            assert 'scheduler_pod_scheduling_attempts_total{result="scheduled"}' in text
+        finally:
+            sched.stop()
+            api.close()
+
+
+class TestTrace:
+    def test_log_if_long(self):
+        t = [0.0]
+        tr = Trace("Scheduling", clock=lambda: t[0], pod="default/x")
+        t[0] = 0.02
+        tr.step("snapshot")
+        t[0] = 0.35
+        tr.step("device dispatch")
+        lines = []
+        assert tr.log_if_long(0.1, sink=lines.append)
+        assert "took 350.0ms" in lines[0] and "device dispatch" in lines[0]
+        tr2 = Trace("fast", clock=lambda: 0.0)
+        assert not tr2.log_if_long(0.1, sink=lines.append)
+
+
+@pytest.fixture
+def api():
+    a = APIServer()
+    yield a
+    a.close()
+
+
+class TestProxier:
+    def test_rules_follow_endpoints(self, api):
+        client = Client.local(api)
+        factory = InformerFactory(client)
+        proxier = Proxier(client, factory)
+        factory.start()
+        factory.wait_for_sync()
+        client.services.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"selector": {"app": "web"}, "clusterIP": "10.96.0.10",
+                     "ports": [{"name": "http", "port": 80,
+                                "targetPort": 8080}]}})
+        client.endpoints.create({
+            "apiVersion": "v1", "kind": "Endpoints",
+            "metadata": {"name": "web", "namespace": "default"},
+            "subsets": [{"addresses": [{"ip": "10.0.0.1"},
+                                       {"ip": "10.0.0.2"}],
+                         "ports": [{"name": "http", "port": 8080}]}]})
+        time.sleep(0.4)
+        assert proxier.sync() >= 1
+        # round robin over both backends
+        picks = {proxier.table.lookup("10.96.0.10", 80) for _ in range(4)}
+        assert picks == {"10.0.0.1:8080", "10.0.0.2:8080"}
+        rules = proxier.table.render_iptables()
+        assert "-d 10.96.0.10/32" in rules and "10.0.0.2:8080" in rules
+        # endpoint removal reprograms
+        ep = client.endpoints.get("web")
+        ep["subsets"][0]["addresses"] = [{"ip": "10.0.0.1"}]
+        client.endpoints.update(ep)
+        time.sleep(0.4)
+        proxier.sync()
+        assert all(proxier.table.lookup("10.96.0.10", 80) == "10.0.0.1:8080"
+                   for _ in range(3))
+        # service deletion drops rules
+        client.services.delete("web")
+        time.sleep(0.4)
+        proxier.sync()
+        assert proxier.table.lookup("10.96.0.10", 80) is None
+
+    def test_session_affinity(self, api):
+        client = Client.local(api)
+        factory = InformerFactory(client)
+        proxier = Proxier(client, factory)
+        factory.start()
+        factory.wait_for_sync()
+        client.services.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "sticky", "namespace": "default"},
+            "spec": {"selector": {"app": "s"}, "clusterIP": "10.96.0.20",
+                     "sessionAffinity": "ClientIP",
+                     "ports": [{"name": "", "port": 80}]}})
+        client.endpoints.create({
+            "apiVersion": "v1", "kind": "Endpoints",
+            "metadata": {"name": "sticky", "namespace": "default"},
+            "subsets": [{"addresses": [{"ip": "10.0.1.1"},
+                                       {"ip": "10.0.1.2"},
+                                       {"ip": "10.0.1.3"}],
+                         "ports": [{"name": "", "port": 80}]}]})
+        time.sleep(0.4)
+        proxier.sync()
+        first = proxier.table.lookup("10.96.0.20", 80, client_ip="1.2.3.4")
+        assert all(proxier.table.lookup("10.96.0.20", 80,
+                                        client_ip="1.2.3.4") == first
+                   for _ in range(5))
+
+
+class TestKubectlAndCluster:
+    def test_kubectl_against_live_cluster(self, tmp_path):
+        with Cluster(ClusterConfig(hollow_nodes=2)) as cluster:
+            out = io.StringIO()
+            argv_base = ["-s", cluster.url]
+            # create via manifest file
+            manifest = tmp_path / "deploy.yaml"
+            manifest.write_text("""
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+  namespace: default
+spec:
+  replicas: 2
+  selector:
+    matchLabels: {app: web}
+  template:
+    metadata:
+      labels: {app: web}
+    spec:
+      containers:
+      - name: c
+        image: img:v1
+""")
+            assert kubectl_main(argv_base + ["apply", "-f", str(manifest)],
+                                out=out) == 0
+            assert "deployment/web created" in out.getvalue()
+            deadline = time.monotonic() + 30
+            client = Client.http(cluster.url)
+            while time.monotonic() < deadline:
+                pods = client.pods.list("default",
+                                        label_selector="app=web")["items"]
+                if len(pods) == 2 and all(
+                        p.get("status", {}).get("phase") == "Running"
+                        for p in pods):
+                    break
+                time.sleep(0.2)
+            out = io.StringIO()
+            assert kubectl_main(argv_base + ["get", "pods"], out=out) == 0
+            lines = out.getvalue().splitlines()
+            assert lines[0].startswith("NAME") and len(lines) == 3
+            assert "Running" in lines[1]
+            # get nodes shows hollow nodes Ready
+            out = io.StringIO()
+            kubectl_main(argv_base + ["get", "nodes"], out=out)
+            assert "Ready" in out.getvalue()
+            # scale through the CLI
+            out = io.StringIO()
+            assert kubectl_main(argv_base + ["scale", "deployment/web",
+                                             "--replicas", "1"], out=out) == 0
+            # cordon + drain one node through the CLI
+            out = io.StringIO()
+            assert kubectl_main(argv_base + ["drain", "hollow-node-0"],
+                                out=out) == 0
+            node = client.nodes.get("hollow-node-0", "")
+            assert node["spec"].get("unschedulable") is True
+            # shortname + describe + api-resources + version round out verbs
+            out = io.StringIO()
+            assert kubectl_main(argv_base + ["get", "deploy"], out=out) == 0
+            assert "web" in out.getvalue()
+            out = io.StringIO()
+            assert kubectl_main(argv_base + ["describe", "deployment", "web"],
+                                out=out) == 0
+            assert "Name:         web" in out.getvalue()
+            out = io.StringIO()
+            assert kubectl_main(argv_base + ["api-resources"], out=out) == 0
+            assert "deployments" in out.getvalue()
+            out = io.StringIO()
+            assert kubectl_main(argv_base + ["version"], out=out) == 0
+            assert "tpu" in out.getvalue()
+
+    def test_kubectl_taint_and_error_paths(self, api):
+        gw = HTTPGateway(api).start()
+        try:
+            client = Client.http(gw.url)
+            client.nodes.create({"apiVersion": "v1", "kind": "Node",
+                                 "metadata": {"name": "n1"}})
+            out, err = io.StringIO(), io.StringIO()
+            assert kubectl_main(["-s", gw.url, "taint", "nodes", "n1",
+                                 "gpu=true:NoSchedule"], out=out) == 0
+            node = client.nodes.get("n1", "")
+            assert node["spec"]["taints"] == [
+                {"key": "gpu", "value": "true", "effect": "NoSchedule"}]
+            assert kubectl_main(["-s", gw.url, "taint", "nodes", "n1",
+                                 "gpu:NoSchedule-"], out=out) == 0
+            assert client.nodes.get("n1", "")["spec"]["taints"] == []
+            # error path: unknown resource type
+            rc = kubectl_main(["-s", gw.url, "get", "flurbs"], out=out,
+                              err=err)
+            assert rc == 1 and "Error from server" in err.getvalue()
+        finally:
+            gw.stop()

@@ -317,14 +317,18 @@ class TestNodeLifecycle:
                    for t in node["spec"]["taints"])
         assert any(c["type"] == "Ready" and c["status"] == "Unknown"
                    for c in node["status"]["conditions"])
-        # eviction after the toleration window
+        # eviction after the toleration window — admission gives every pod
+        # the default 300 s unreachable toleration, so eviction waits for it
         fake_now[0] = 1200.0
         time.sleep(0.3)  # let the informer see the taint
+        nlc.poll_once()
+        assert _exists(client.pods, "victim")  # 150 s < 300 s toleration
+        fake_now[0] = 1400.0  # past taint-time + 300 s
         nlc.poll_once()
         assert wait_for(lambda: not _exists(client.pods, "victim"))
         # recovery: heartbeat resumes → taint removed
         node = client.nodes.get("n1", "")
-        node["status"]["conditions"][0]["heartbeatUnix"] = 1199.0
+        node["status"]["conditions"][0]["heartbeatUnix"] = 1399.0
         client.nodes.update_status(node, "")
         time.sleep(0.3)
         nlc.poll_once()
