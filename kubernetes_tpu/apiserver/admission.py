@@ -322,25 +322,34 @@ class EvictionPDBGate(AdmissionPlugin):
             return obj
         ns = meta.namespace(old) or "default"
         store = api.store("policy", "poddisruptionbudgets")
-        for pdb in pdbs_for_pod(api, old):
-            allowed = int(pdb.get("status", {}).get("disruptionsAllowed", 0))
-            if allowed <= 0:
+        pdbs = pdbs_for_pod(api, old)
+        if not pdbs:
+            return obj
+        if len(pdbs) > 1:
+            # the reference refuses multi-PDB evictions outright
+            # (eviction.go: "This pod has more than one PodDisruptionBudget")
+            # — which also makes the decrement below single-budget atomic
+            raise errors.StatusError(
+                500, "InternalError",
+                "This pod has more than one PodDisruptionBudget, which the "
+                "Eviction subresource does not support.")
+        pdb = pdbs[0]
+
+        # the CAS inside guaranteed_update is the one authoritative check:
+        # N concurrent evictions serialize on it and cannot all pass
+        def dec(o):
+            st = o.setdefault("status", {})
+            cur = int(st.get("disruptionsAllowed", 0))
+            if cur <= 0:
                 raise errors.new_too_many_requests(
                     "Cannot evict pod as it would violate the pod's "
                     "disruption budget.")
-            # optimistic decrement so N concurrent evictions can't all pass
-            def dec(o):
-                st = o.setdefault("status", {})
-                cur = int(st.get("disruptionsAllowed", 0))
-                if cur <= 0:
-                    raise errors.new_too_many_requests(
-                        "Cannot evict pod as it would violate the pod's "
-                        "disruption budget.")
-                st["disruptionsAllowed"] = cur - 1
-                return o
-            store.storage.guaranteed_update(
-                store.key_for(ns, meta.name(pdb)), dec,
-                "poddisruptionbudgets", meta.name(pdb))
+            st["disruptionsAllowed"] = cur - 1
+            return o
+
+        store.storage.guaranteed_update(
+            store.key_for(ns, meta.name(pdb)), dec,
+            "poddisruptionbudgets", meta.name(pdb))
         return obj
 
 

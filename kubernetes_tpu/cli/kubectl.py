@@ -289,7 +289,9 @@ class Kubectl:
         rc = self._rc(resource)
         patch: Dict[str, Any] = {}
         for pair in kv:
-            if pair.endswith("-"):
+            # only `key-` (no '=') is a removal; a VALUE ending in '-' is
+            # a legitimate assignment (kubectl parseLabels)
+            if "=" not in pair and pair.endswith("-"):
                 patch[pair[:-1]] = None
             else:
                 k, _, v = pair.partition("=")

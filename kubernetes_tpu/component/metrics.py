@@ -100,6 +100,10 @@ class Histogram(_Metric):
         with self._mu:
             return self._totals.get(self._key(labels), 0)
 
+    def sum_value(self, **labels) -> float:
+        with self._mu:
+            return self._sums.get(self._key(labels), 0.0)
+
     def quantile(self, q: float, **labels) -> float:
         """Approximate quantile from bucket boundaries (for tests/SLO checks;
         Prometheus computes this server-side with histogram_quantile)."""

@@ -67,12 +67,22 @@ class RuleTable:
     def replace_service(self, key_ns: str, key_name: str,
                         rules: Dict[str, ServicePortRules]) -> None:
         with self._mu:
-            # drop this service's old ports, install the new set
+            # drop this service's old ports, install the new set; active
+            # session-affinity pins and the round-robin cursor survive a
+            # reprogram (the kernel's conntrack does in the reference)
+            old_rules: Dict[str, ServicePortRules] = {}
             for (ns, name, pname) in [k for k in self.by_port
                                       if k[0] == key_ns and k[1] == key_name]:
                 old = self.by_port.pop((ns, name, pname))
+                old_rules[pname] = old
                 self.by_vip.pop((old.cluster_ip, old.port), None)
             for pname, r in rules.items():
+                prev = old_rules.get(pname)
+                if prev is not None:
+                    r._affinity = {ip: pin for ip, pin in
+                                   prev._affinity.items()
+                                   if pin[0] in r.endpoints}
+                    r._rr = prev._rr
                 self.by_port[(key_ns, key_name, pname)] = r
                 if r.cluster_ip:
                     self.by_vip[(r.cluster_ip, r.port)] = (key_ns, key_name,
@@ -175,14 +185,18 @@ class Proxier:
             cluster_ip = self._cluster_ip(svc)
             for p in svc.get("spec", {}).get("ports", []) or []:
                 pname = p.get("name", "")
+                tp = p.get("targetPort", p.get("port", 0))
+                if isinstance(tp, str) and tp.isdigit():
+                    tp = int(tp)  # IntOrString: numeric strings are ports
                 backends: List[str] = []
                 for ss in subsets:
                     eps_port = next(
                         (int(sp.get("port", 0)) for sp in ss.get("ports", [])
                          if sp.get("name", "") == pname),
-                        int(p.get("targetPort", p.get("port", 0))
-                            if not isinstance(p.get("targetPort"), str)
-                            else p.get("port", 0)))
+                        # fall back to the literal target port; a NAMED
+                        # target port unresolvable via endpoints port names
+                        # keeps the service port (nothing better is known)
+                        tp if isinstance(tp, int) else int(p.get("port", 0)))
                     for addr in ss.get("addresses", []) or []:
                         backends.append(f"{addr['ip']}:{eps_port}")
                 rules[pname] = ServicePortRules(

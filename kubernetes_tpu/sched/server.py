@@ -49,14 +49,24 @@ class SchedulerServer:
     def __init__(self, client, scheduler: Optional[Scheduler] = None,
                  scheduler_name: str = "default-scheduler",
                  cycle_interval: float = 0.05,
+                 batch_window: float = 0.02,
                  leader_elect: bool = False):
+        from kubernetes_tpu.state.dims import Dims
+
         self.client = client
         self.recorder = EventRecorder(client, component=scheduler_name)
         self.scheduler = scheduler or Scheduler(
-            binder=APIBinder(client), scheduler_name=scheduler_name)
+            binder=APIBinder(client), scheduler_name=scheduler_name,
+            # shape floor: tiny waves share one compiled (P,N,E) signature
+            # instead of recompiling at every power-of-two batch size
+            base_dims=Dims(N=64, P=128, E=512))
         if self.scheduler.binder is None:
             self.scheduler.binder = APIBinder(client)
         self.cycle_interval = cycle_interval
+        # debounce: when pods flood in, wait this long so one batched device
+        # wave absorbs them instead of many tiny waves (adds at most this
+        # much latency to an isolated pod)
+        self.batch_window = batch_window
         self._creation_seq = 0
         self._stop = threading.Event()
         self._threads = []
@@ -169,6 +179,10 @@ class SchedulerServer:
             if not self._active.is_set():
                 self._stop.wait(0.2)
                 continue
+            with self._mu:
+                pending = self.scheduler.queue.lengths()[0]
+            if pending and self.batch_window:
+                self._stop.wait(self.batch_window)  # let the batch fill
             stats = self.run_one_wave()
             if stats is None or stats.attempted == 0:
                 self._stop.wait(self.cycle_interval)

@@ -284,3 +284,95 @@ spec:
             assert rc == 1 and "Error from server" in err.getvalue()
         finally:
             gw.stop()
+
+
+class TestReviewRegressions:
+    def test_affinity_survives_reprogram(self, api):
+        """Session pins and the RR cursor carry across endpoint updates."""
+        client = Client.local(api)
+        factory = InformerFactory(client)
+        proxier = Proxier(client, factory)
+        factory.start()
+        factory.wait_for_sync()
+        client.services.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "pin", "namespace": "default"},
+            "spec": {"selector": {"app": "p"}, "clusterIP": "10.96.0.30",
+                     "sessionAffinity": "ClientIP",
+                     "ports": [{"name": "", "port": 80}]}})
+        client.endpoints.create({
+            "apiVersion": "v1", "kind": "Endpoints",
+            "metadata": {"name": "pin", "namespace": "default"},
+            "subsets": [{"addresses": [{"ip": "10.2.0.1"}, {"ip": "10.2.0.2"}],
+                         "ports": [{"name": "", "port": 80}]}]})
+        time.sleep(0.4)
+        proxier.sync()
+        pinned = proxier.table.lookup("10.96.0.30", 80, client_ip="9.9.9.9")
+        # add a third backend: the pin must hold
+        ep = client.endpoints.get("pin")
+        ep["subsets"][0]["addresses"].append({"ip": "10.2.0.3"})
+        client.endpoints.update(ep)
+        time.sleep(0.4)
+        proxier.sync()
+        assert proxier.table.lookup("10.96.0.30", 80,
+                                    client_ip="9.9.9.9") == pinned
+
+    def test_numeric_string_target_port(self, api):
+        client = Client.local(api)
+        factory = InformerFactory(client)
+        proxier = Proxier(client, factory)
+        factory.start()
+        factory.wait_for_sync()
+        client.services.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "strport", "namespace": "default"},
+            "spec": {"selector": {"app": "s"}, "clusterIP": "10.96.0.40",
+                     "ports": [{"name": "web", "port": 80,
+                                "targetPort": "8080"}]}})
+        client.endpoints.create({
+            "apiVersion": "v1", "kind": "Endpoints",
+            "metadata": {"name": "strport", "namespace": "default"},
+            "subsets": [{"addresses": [{"ip": "10.3.0.1"}],
+                         "ports": [{"name": "other", "port": 9999}]}]})
+        time.sleep(0.4)
+        proxier.sync()
+        # quoted numeric targetPort routes to 8080, not the service port
+        assert proxier.table.lookup("10.96.0.40", 80) == "10.3.0.1:8080"
+
+    def test_label_value_ending_in_dash(self, api):
+        gw = HTTPGateway(api).start()
+        try:
+            client = Client.http(gw.url)
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "lbl", "namespace": "default"},
+                "spec": {"containers": [{"name": "c"}]}})
+            out = io.StringIO()
+            assert kubectl_main(["-s", gw.url, "label", "pods", "lbl",
+                                 "branch=feature-x-"], out=out) == 0
+            assert client.pods.get("lbl")["metadata"]["labels"] == {
+                "branch": "feature-x-"}
+            assert kubectl_main(["-s", gw.url, "label", "pods", "lbl",
+                                 "branch-"], out=out) == 0
+            assert client.pods.get("lbl")["metadata"].get("labels", {}) == {}
+        finally:
+            gw.stop()
+
+    def test_multi_pdb_eviction_refused(self, api):
+        for n in ("pdb-a", "pdb-b"):
+            api.store("policy", "poddisruptionbudgets").create("default", {
+                "apiVersion": "policy/v1beta1", "kind": "PodDisruptionBudget",
+                "metadata": {"name": n, "namespace": "default"},
+                "spec": {"minAvailable": 0,
+                         "selector": {"matchLabels": {"app": "multi"}}}})
+        api.store("", "pods").create("default", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "m1", "namespace": "default",
+                         "labels": {"app": "multi"}},
+            "spec": {"containers": [{"name": "c"}]}})
+        import pytest as _pytest
+        from kubernetes_tpu.machinery import errors as merrors
+        with _pytest.raises(merrors.StatusError) as ei:
+            api.evict_pod("default", "m1", {})
+        assert ei.value.code == 500
+        assert "more than one" in ei.value.message
