@@ -14,7 +14,14 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from kubernetes_tpu.api.types import Pod
+import dataclasses
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    NodeSelector,
+    NodeSelectorTerm,
+    Pod,
+)
 from kubernetes_tpu.api.v1 import node_from_v1, pod_from_v1
 from kubernetes_tpu.client.events import EventRecorder
 from kubernetes_tpu.client.informers import SharedInformer
@@ -29,18 +36,45 @@ Obj = Dict[str, Any]
 
 
 class APIBinder:
-    """Binder over POST pods/{name}/binding (scheduler.go:565)."""
+    """Binder over POST pods/{name}/binding (scheduler.go:565). When volume
+    binding is wired, BindPodVolumes runs first (scheduler.go:660,517) and a
+    volume failure aborts the pod bind → assume rollback."""
 
-    def __init__(self, client):
+    def __init__(self, client, volume_binder=None, pod_lookup=None):
         self.client = client
+        self.volume_binder = volume_binder
+        self.pod_lookup = pod_lookup  # (ns, name) -> dict pod or None
 
     def bind(self, pod: Pod, node_name: str) -> bool:
+        if self.volume_binder is not None and self.pod_lookup is not None:
+            obj = self.pod_lookup(pod.namespace, pod.name)
+            if obj is not None and not self.volume_binder.bind(obj, node_name):
+                return False
         try:
             self.client.pods.bind(pod.name, node_name, pod.namespace,
                                   uid=pod.uid)
             return True
         except errors.StatusError:
             return False
+
+
+def restrict_pod_nodes(pod: Pod, allowed: frozenset) -> Pod:
+    """AND a node-name restriction into the pod's required node affinity by
+    adding matchFields(metadata.name IN allowed) to every term (or one fresh
+    term) — evaluated on device like any other affinity."""
+    names = tuple(sorted(allowed))
+    aff = pod.affinity
+    if aff.node_required and aff.node_required.terms:
+        terms = tuple(
+            dataclasses.replace(t, field_name_in=tuple(
+                sorted(set(t.field_name_in) & allowed
+                       if t.field_name_in else allowed)) or ("",))
+            for t in aff.node_required.terms)
+    else:
+        terms = (NodeSelectorTerm(field_name_in=names),)
+    pod.affinity = dataclasses.replace(
+        aff, node_required=NodeSelector(terms=terms))
+    return pod
 
 
 class SchedulerServer:
@@ -50,7 +84,8 @@ class SchedulerServer:
                  scheduler_name: str = "default-scheduler",
                  cycle_interval: float = 0.05,
                  batch_window: float = 0.02,
-                 leader_elect: bool = False):
+                 leader_elect: bool = False,
+                 volume_binding: bool = True):
         from kubernetes_tpu.state.dims import Dims
 
         self.client = client
@@ -67,6 +102,12 @@ class SchedulerServer:
         # wave absorbs them instead of many tiny waves (adds at most this
         # much latency to an isolated pod)
         self.batch_window = batch_window
+        # volume binding (CheckVolumeBinding/NoVolumeZoneConflict +
+        # WaitForFirstConsumer coordination); informers wired in start()
+        self.volume_binding = volume_binding
+        self.volume_binder = None
+        self.pvc_informer = self.pv_informer = self.sc_informer = None
+        self._waiting_on_volumes: set = set()  # pod keys parked on PVCs
         self._creation_seq = 0
         self._stop = threading.Event()
         self._threads = []

@@ -1,0 +1,16 @@
+"""Volume machinery: PV/PVC binding + scheduler volume coordination.
+
+TPU-native analog of `pkg/controller/volume/persistentvolume` (the PV
+binder controller) and `pkg/controller/volume/scheduling` +
+`pkg/scheduler/volumebinder` (the scheduler-coordinated delayed-binding
+path, SURVEY §2.1 volume binder row).
+"""
+
+from kubernetes_tpu.volume.binder import SchedulerVolumeBinder, VolumeDecision
+from kubernetes_tpu.volume.pv_controller import (
+    PersistentVolumeController,
+    pv_matches_claim,
+)
+
+__all__ = ["PersistentVolumeController", "SchedulerVolumeBinder",
+           "VolumeDecision", "pv_matches_claim"]
