@@ -162,8 +162,17 @@ def attributes_from_request(user: UserInfo, method: str, path: str,
     sub = rest[2] if len(rest) > 2 else ""
     if sub:
         resource = f"{resource}/{sub}"
-    if method == "GET" and not name:
-        verb = "watch" if query.get("watch") in ("true", "1") else "list"
+    if method == "GET":
+        # ?watch=true is the watch verb even with a name: this server streams
+        # single-object watches directly, so they must require the watch
+        # permission. DIVERGES from the reference RequestInfoFactory, which
+        # rewrites the verb only for nameless requests (requestinfo.go:210,
+        # single-object watch there goes through a fieldSelector list) —
+        # see docs/PARITY.md. Plain named GET stays "get".
+        if query.get("watch") in ("true", "1"):
+            verb = "watch"
+        elif not name:
+            verb = "list"
     return Attributes(user, verb, group, resource, namespace, name, path)
 
 

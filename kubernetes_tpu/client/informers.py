@@ -211,7 +211,10 @@ class SharedInformer:
             k = meta.namespaced_key(o)
             for add, upd, _ in handlers:
                 if k in old_keys:
-                    upd(o, o)
+                    # deliver the pre-gap cached object as old so diffing
+                    # handlers see changes that happened during the watch gap
+                    # (DeltaFIFO Replace semantics)
+                    upd(old_objs.get(k) or o, o)
                 else:
                     add(o)
         for k in old_keys - new_keys:

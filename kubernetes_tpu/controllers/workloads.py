@@ -87,10 +87,12 @@ class ReplicaSetController(Controller):
             for _ in range(n - created):  # lower expectations for failures
                 self.expectations.creation_observed(key)
         elif diff < 0:
-            # prefer deleting not-ready/youngest (getPodsToDelete ranking)
+            # prefer deleting not-ready, then youngest (getPodsToDelete
+            # ranking: newer pods go first among equally-ready ones)
             victims = sorted(
-                pods, key=lambda p: (is_pod_ready(p),
-                                     p["metadata"].get("creationTimestamp", "")))
+                pods, key=lambda p: p["metadata"].get("creationTimestamp", ""),
+                reverse=True)
+            victims.sort(key=is_pod_ready)  # stable: not-ready first
             victims = victims[:(-diff)]
             self.expectations.expect_deletions(key, len(victims))
             for p in victims:
@@ -190,7 +192,8 @@ class DeploymentController(Controller):
         else:
             ru = strategy.get("rollingUpdate", {})
             max_surge = _resolve_pct(ru.get("maxSurge", "25%"), desired)
-            max_unavail = _resolve_pct(ru.get("maxUnavailable", "25%"), desired)
+            max_unavail = _resolve_pct(
+                ru.get("maxUnavailable", "25%"), desired, round_up=False)
             if max_surge == 0 and max_unavail == 0:
                 max_unavail = 1
             total = sum(int(rs["spec"].get("replicas", 0))
@@ -244,10 +247,14 @@ class DeploymentController(Controller):
                     return
 
 
-def _resolve_pct(v, total: int) -> int:
+def _resolve_pct(v, total: int, round_up: bool = True) -> int:
+    """GetValueFromIntOrPercent: maxSurge rounds up, maxUnavailable rounds
+    DOWN so availability never dips below the requested floor
+    (deployment/util ResolveFenceposts)."""
     if isinstance(v, str) and v.endswith("%"):
         import math
-        return math.ceil(total * int(v[:-1]) / 100)
+        frac = total * int(v[:-1]) / 100
+        return math.ceil(frac) if round_up else math.floor(frac)
     return int(v)
 
 

@@ -216,12 +216,21 @@ class NativeKV:
 class PyKV:
     """Pure-Python replica of NativeKV (same interface, same semantics)."""
 
+    MAX_EVENTS = 1 << 20  # mirror NativeKV's cap: bound the log for the
+    # process lifetime even when nothing calls compact()
+
     def __init__(self) -> None:
         self._mu = threading.Condition()
         self._data: dict = {}  # key -> (value, create_rev, mod_rev)
         self._events: List[KVEvent] = []
         self._rev = 0
         self._compacted = 0
+
+    def _trim_locked(self) -> None:
+        if len(self._events) > self.MAX_EVENTS:
+            drop = len(self._events) - self.MAX_EVENTS
+            self._compacted = self._events[drop - 1].rev
+            del self._events[:drop]
 
     def close(self) -> None:
         pass
@@ -249,6 +258,7 @@ class PyKV:
             self._data[key] = (value, create, self._rev)
             self._events.append(KVEvent(
                 self._rev, EVENT_PUT if cur else EVENT_CREATE, key, value))
+            self._trim_locked()
             self._mu.notify_all()
             return self._rev
 
@@ -262,6 +272,7 @@ class PyKV:
             self._rev += 1
             del self._data[key]
             self._events.append(KVEvent(self._rev, EVENT_DELETE, key, cur[0]))
+            self._trim_locked()
             self._mu.notify_all()
             return self._rev
 

@@ -29,4 +29,39 @@ def ensure_cpu_backend_safe(argv: list[str] | None = None) -> None:
     env = dict(os.environ)
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["KTPU_CPU_REEXEC"] = "1"
-    os.execve(sys.executable, [sys.executable] + (argv or sys.argv), env)
+    os.execve(sys.executable, [sys.executable] + (argv or _original_args()), env)
+
+
+def cpu_disarmed_env(env: dict | None = None) -> dict:
+    """A copy of `env` (default os.environ) set up so a fresh child process
+    comes up on the XLA CPU backend with the axon site hook disarmed — the
+    subprocess counterpart of ensure_cpu_backend_safe()."""
+    out = dict(os.environ if env is None else env)
+    out["JAX_PLATFORMS"] = "cpu"
+    out["PALLAS_AXON_POOL_IPS"] = ""  # disarm the axon site hook
+    out["KTPU_CPU_REEXEC"] = "1"  # child needs no re-exec
+    return out
+
+
+def _original_args() -> list[str]:
+    """Interpreter args of THIS process, faithfully enough to re-exec.
+
+    sys.argv is lossy: under ``python -c "code"`` it is ``['-c', ...]`` — the
+    code string is gone, so re-exec'ing sys.argv hands the child a bare ``-c``.
+    /proc/self/cmdline has the real thing (NUL-separated, includes interpreter
+    flags like -X/-O that sys.argv also drops), so prefer it on Linux.
+    """
+    try:
+        raw = open("/proc/self/cmdline", "rb").read().split(b"\0")
+        args = [a.decode() for a in raw if a]
+        if len(args) >= 2:
+            return args[1:]  # drop the interpreter path itself
+    except OSError:
+        pass
+    if sys.argv and sys.argv[0] in ("-c", "-m"):
+        raise RuntimeError(
+            "ensure_cpu_backend_safe: cannot reconstruct a `python %s` command "
+            "line without /proc; set PALLAS_AXON_POOL_IPS='' KTPU_CPU_REEXEC=1 "
+            "in the environment instead" % sys.argv[0]
+        )
+    return sys.argv

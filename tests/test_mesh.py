@@ -1,0 +1,91 @@
+"""Multi-chip sharding correctness: the node-axis-sharded cycle must produce
+bit-identical results to the unsharded one.
+
+The reference parallelizes Filter/Score with 16 goroutines over node chunks
+(workqueue.ParallelizeUntil, core/generic_scheduler.go:537,770) and unit-tests
+that path; here the chunking is a jax.sharding.Mesh over the node axis and the
+collectives (argmax / any-reductions across chips) are inserted by XLA GSPMD
+from the sharding annotations — this test is what makes that claim *tested*
+rather than asserted (conftest forces 8 virtual CPU devices).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_tpu.models.workloads import flagship_pods, make_nodes
+from kubernetes_tpu.ops.assign import assign_batch, feasible_matrix, initial_state
+from kubernetes_tpu.ops.lattice import build_cycle
+from kubernetes_tpu.parallel.mesh import make_mesh, replicate, shard_tables
+from kubernetes_tpu.sched.cycle import UNSCHEDULABLE_TAINT_KEY
+from kubernetes_tpu.state.dims import Dims
+from kubernetes_tpu.state.encode import Encoder
+
+
+def _encode(n_nodes, n_pods):
+    nodes = make_nodes(n_nodes, zones=min(8, n_nodes), racks_per_zone=4)
+    pods = flagship_pods(n_pods, groups=min(12, n_pods))
+    enc = Encoder()
+    enc.vocabs.label_keys.intern(UNSCHEDULABLE_TAINT_KEY)
+    enc.vocabs.label_vals.intern("")
+    tables, ex, pe, d = enc.encode_cluster(nodes, [], pods, Dims(N=n_nodes, P=n_pods))
+    uk = jnp.int32(enc.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+    ev = jnp.int32(enc.vocabs.label_vals.get(""))
+    return tables, pe, ex, uk, ev, d
+
+
+def _cycle(tables, pending, existing, uk, ev, D):
+    cyc = build_cycle(tables, existing, uk, ev, D)
+    init = initial_state(tables, cyc)
+    res = assign_batch(tables, cyc, pending, init)
+    feas = feasible_matrix(tables, cyc, pending)
+    return res.node, res.feasible, res.state.used, feas
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    return _encode(64, 96)
+
+
+def test_mesh_requires_enough_devices():
+    with pytest.raises(RuntimeError, match="devices visible"):
+        make_mesh(len(jax.devices()) + 1)
+
+
+def test_sharded_cycle_matches_unsharded(cluster):
+    tables, pending, existing, uk, ev, d = cluster
+    D = d.D
+
+    fn = jax.jit(lambda t, p, e, u, v: _cycle(t, p, e, u, v, D))
+
+    # unsharded (single-device) reference run
+    ref_node, ref_feas, ref_used, ref_mat = jax.tree.map(
+        np.asarray, fn(tables, pending, existing, uk, ev)
+    )
+
+    # sharded over the 8-virtual-device mesh: node tables split on N,
+    # everything else replicated; GSPMD inserts the cross-chip reductions
+    mesh = make_mesh(8)
+    st = shard_tables(tables, mesh)
+    sp = replicate(pending, mesh)
+    se = replicate(existing, mesh)
+    got_node, got_feas, got_used, got_mat = jax.tree.map(
+        np.asarray, fn(st, sp, se, uk, ev)
+    )
+
+    assert int(got_feas.sum()) > 0, "sharded cycle scheduled nothing"
+    np.testing.assert_array_equal(got_node, ref_node)
+    np.testing.assert_array_equal(got_feas, ref_feas)
+    np.testing.assert_array_equal(got_used, ref_used)
+    np.testing.assert_array_equal(got_mat, ref_mat)
+
+
+def test_sharded_tables_placement(cluster):
+    tables, *_ = cluster
+    mesh = make_mesh(8)
+    st = shard_tables(tables, mesh)
+    # node rows live split across all 8 devices; class tables are replicated
+    assert len(st.nodes.alloc.sharding.device_set) == 8
+    assert not st.nodes.alloc.sharding.is_fully_replicated
+    assert st.classes.rid.sharding.is_fully_replicated
