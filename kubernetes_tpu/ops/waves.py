@@ -1,0 +1,399 @@
+"""Wave-parallel batched assignment: the scheduling cycle as a fixpoint of
+dense [SC, N] evaluations instead of a P-step sequential scan.
+
+The reference schedules one pod at a time (scheduler.go:596-763); ops/assign.py
+reproduces that literally as a lax.scan whose 50k serialized steps leave the
+TPU idle. This module replaces it as the default path. Per wave:
+
+  1. every pod CLASS still holding pending pods evaluates its full Filter mask
+     and Score row against the committed state — one vmapped dense pass over
+     [SC, N], the shape the MXU/VPU wants (pods of a class are spec-identical,
+     so per-pod rows would be redundant);
+  2. only classes whose next queued pod sits in the current top priority tier
+     admit this wave (activeQ order: priority desc, creation asc —
+     internal/queue/scheduling_queue.go:119-138);
+  3. each admitting class claims up to one pod per node on its top-scored
+     feasible nodes, subject to per-domain quotas that make every same-wave
+     admission pair NON-INTERFERING:
+       - hard topology-spread (predicates.go:1643): at most
+         maxSkew + minMatch − count(d) new matching pods per domain d
+         (the criticalPaths online-min, metadata.go:78-112, evaluated at
+         wave start — conservative, never violating);
+       - self-matching anti-affinity (predicates.go:1447-1456): one pod per
+         domain per wave;
+       - required-affinity first-pod escape (predicates.go:1436-1440): a class
+         whose terms have zero matches admits exactly one pod, so followers
+         co-locate with it next wave;
+  4. cross-CLASS term interactions (my anti/spread/affinity term matches your
+     pods) are serialized through an [SC, SC] interaction graph: a class
+     admits only if no earlier-queued class it interacts with admits in the
+     same wave (vectorized independent set — no scan);
+  5. same-node contention between classes is resolved in queue order by a
+     cumulative resource-sum / port-OR pass; losers retry next wave;
+  6. zero-progress waves mark the entire frozen priority-tier run of each
+     attempting class unschedulable — exactly the outcome of the sequential
+     scan replayed with unchanging state — so the loop always terminates.
+
+Soundness invariant (tested in tests/test_waves.py): replaying the final
+assignment wave-by-wave, each pod in queue order, every placement passes the
+full Filter mask at replay time — i.e. the output is a valid greedy execution
+of the reference's per-pod loop. Deviations (which valid execution gets
+picked) are documented in docs/PARITY.md.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..state.arrays import Array, ClusterTables, PodArrays
+from .assign import AssignResult, AssignState, pod_mask_row
+from .fit import _fit, resource_scores_row
+from .interpod import class_term_membership, domain_agg, soft_affinity_row
+from .lattice import CycleArrays
+
+# plain Python ints only: a module-level jnp scalar would be captured as a
+# closure *device array* and hoisted into executable parameters, which
+# miscompiles under multi-trace dispatch (jax 0.9 CPU)
+_I32_MAX = int(jnp.iinfo(jnp.int32).max)
+_I32_MIN = int(jnp.iinfo(jnp.int32).min)
+
+
+class _WaveCarry(NamedTuple):
+    state: AssignState
+    cursor: Array     # [SC] pods consumed per class (placed or tier-failed)
+    placed: Array     # [SC] pods actually placed per class
+    node_out: Array   # [P+1] chosen node per sorted-pod slot (last = sink)
+    wave_out: Array   # [P+1] wave index each pod was admitted in (-1 = never)
+    waves: Array      # scalar i32
+
+
+def interaction_graph(tables: ClusterTables, cyc: CycleArrays) -> Array:
+    """G [SC, SC]: classes whose same-wave admissions could interact through
+    affinity/anti-affinity/hard-spread terms (resource/port contention is
+    resolved per node instead and needs no edge). Symmetric, no self-edges —
+    a class's interaction with itself is handled exactly by the per-domain
+    quotas."""
+    classes = tables.classes
+    S = cyc.TM.shape[0]
+    M = cyc.TM.astype(jnp.int32)  # [S, SC] term matches class
+
+    def edges(member: Array) -> Array:  # member: [SC, S]
+        return (member.astype(jnp.int32) @ M) > 0  # [SC, SC]
+
+    anti = edges(cyc.has_anti)
+    hard_spread_ids = jnp.where(classes.tsc_hard, classes.tsc_term, -1)
+    spread = edges(class_term_membership(hard_spread_ids, S))
+    aff = edges(class_term_membership(classes.aff_terms, S))
+    G = anti | anti.T | spread | spread.T | aff | aff.T
+    G = G & classes.valid[:, None] & classes.valid[None, :]
+    return G & ~jnp.eye(G.shape[0], dtype=bool)
+
+
+def _class_mask_score(tables, cyc, state):
+    """[SC, N] Filter mask + Score for every class against `state` — the
+    dense analog of findNodesThatFit + prioritizeNodes, once per class."""
+    classes = tables.classes
+    nodes = tables.nodes
+    terms = tables.terms
+    D = cyc.ELD.shape[2] - 1
+    SC = classes.valid.shape[0]
+
+    def row(c):
+        mask = pod_mask_row(tables, cyc, state, c, jnp.int32(-1),
+                            classes.valid[c])
+        req_vec = tables.reqs.vec[classes.rid[c]]
+        least, balanced = resource_scores_row(req_vec, state.used, nodes.alloc)
+        soft = soft_affinity_row(c, classes, terms, state.CNT, nodes, D)
+        score = cyc.static.score[c] + least + balanced + soft
+        return mask, jnp.where(mask, score, -jnp.inf)
+
+    return jax.vmap(row)(jnp.arange(SC))
+
+
+def _domain_quota_pass(tables, cyc, state, mask, order_n, allowed_sorted):
+    """AND per-domain admission quotas into `allowed_sorted` [SC, N] (nodes in
+    per-class score order). Quotas keep same-wave same-class admissions from
+    violating hard spread / self-anti-affinity when replayed sequentially."""
+    classes = tables.classes
+    nodes = tables.nodes
+    terms = tables.terms
+    D = cyc.ELD.shape[2] - 1
+    SC, N = mask.shape
+    TS = classes.tsc_term.shape[1]
+    AN = classes.anti_terms.shape[1]
+
+    def slot_quota(c, s_id, topo_key, active, quota_d):
+        """quota_d: [D+1] cap per domain; returns [N] allowed-in-sorted-order
+        for this class/slot. rank-in-domain is computed by a (domain, score
+        rank) lexsort — O(N log N), never materializing an [N, D] one-hot
+        (D can be N itself for hostname-keyed constraints)."""
+        k = jnp.maximum(topo_key, 0)
+        dom = jnp.where((topo_key >= 0) & nodes.valid, nodes.domain[:, k], -1)
+        dom_sorted = dom[order_n[c]]                  # [N] score-desc order
+        dsafe = jnp.where(dom_sorted >= 0, dom_sorted, D)
+        # stable-sort score-ordered positions by domain: within each domain
+        # group the score order is preserved, so rank-in-domain = index in
+        # the grouped array minus the group's start index
+        gidx = jnp.arange(N, dtype=jnp.int32)
+        grp = jnp.argsort(dsafe, stable=True)         # grouped order
+        dom_g = dsafe[grp]
+        start = jnp.full((D + 1,), N, jnp.int32).at[dom_g].min(gidx)
+        rank_g = gidx - start[dom_g]
+        rank_in_dom = jnp.zeros((N,), jnp.int32).at[grp].set(rank_g)
+        return ~active | (rank_in_dom < quota_d[dsafe])
+
+    # --- hard topology-spread slots (only self-matching classes move their
+    # own counts; others are quota-free here and guarded by the graph) ---
+    for t in range(TS):
+        def spread_slot(c):
+            s_id = classes.tsc_term[c, t]
+            s = jnp.maximum(s_id, 0)
+            active = (
+                (s_id >= 0) & classes.tsc_hard[c, t] & cyc.TM[s, c]
+            )
+            eld = cyc.ELD[c, t, :D]
+            active = active & eld.any()
+            k = terms.topo_key[s]
+            dom = jnp.where((k >= 0) & nodes.valid,
+                            nodes.domain[:, jnp.maximum(k, 0)], -1)
+            seg = domain_agg(state.CNT[s][None], dom[None], D,
+                             eligible=cyc.static.node_match[c][None])[0]
+            min_cnt = jnp.min(jnp.where(eld, seg[:D], _I32_MAX))
+            quota = jnp.clip(
+                classes.tsc_maxskew[c, t] + min_cnt - seg, 0, _I32_MAX
+            )
+            quota = jnp.where(active, quota, _I32_MAX)
+            return slot_quota(c, s_id, k, active, quota)
+
+        allowed_sorted = allowed_sorted & jax.vmap(spread_slot)(jnp.arange(SC))
+
+    # --- self-matching anti-affinity slots: one per domain per wave ---
+    for t in range(AN):
+        def anti_slot(c):
+            s_id = classes.anti_terms[c, t]
+            s = jnp.maximum(s_id, 0)
+            k = terms.topo_key[s]
+            active = (s_id >= 0) & cyc.TM[s, c] & (k >= 0)
+            quota = jnp.where(active, jnp.ones((D + 1,), jnp.int32),
+                              _I32_MAX)
+            return slot_quota(c, s_id, k, active, quota)
+
+        allowed_sorted = allowed_sorted & jax.vmap(anti_slot)(jnp.arange(SC))
+
+    return allowed_sorted
+
+
+def _escape_cap(tables, cyc, state, r):
+    """Required-affinity first-pod escape: a class whose required terms have
+    zero potential matches (predicates.go:1436-1440) admits at most ONE pod
+    this wave, so the followers see its counts next wave."""
+    classes = tables.classes
+    terms = tables.terms
+    nodes = tables.nodes
+
+    def one(c):
+        ats = classes.aff_terms[c]
+        s = jnp.maximum(ats, 0)
+        active = ats >= 0
+        k = terms.topo_key[s]
+        has_key = (k[:, None] >= 0) & nodes.valid[None, :]
+        total = jnp.sum(jnp.where(active[:, None] & has_key,
+                                  state.CNT[s], 0))
+        return active.any() & (total == 0)
+
+    escape = jax.vmap(one)(jnp.arange(classes.valid.shape[0]))
+    return jnp.where(escape, jnp.minimum(r, 1), r)
+
+
+def assign_waves(
+    tables: ClusterTables,
+    cyc: CycleArrays,
+    pods: PodArrays,
+    init: AssignState,
+    max_waves: int | None = None,
+    return_waves: bool = False,
+) -> AssignResult:
+    """Drop-in replacement for ops/assign.py:assign_batch (same signature,
+    same result type). See the module docstring for the algorithm."""
+    classes = tables.classes
+    nodes = tables.nodes
+    SC = classes.valid.shape[0]
+    N = nodes.valid.shape[0]
+    P = pods.valid.shape[0]
+    R = tables.reqs.vec.shape[1]
+
+    G = interaction_graph(tables, cyc)
+    req_by_class = tables.reqs.vec[jnp.maximum(classes.rid, 0)]  # [SC, R]
+
+    # --- queue order, grouped by class (activeQ comparator within class) ---
+    cls_safe = jnp.where(pods.valid, pods.cls, SC)
+    sorted_pods = jnp.lexsort((pods.creation, -pods.priority, cls_safe))  # [P]
+    class_total = (
+        jnp.zeros((SC + 1,), jnp.int32)
+        .at[cls_safe].add(1)[:SC]
+    )
+    class_offset = jnp.cumsum(class_total) - class_total  # [SC] exclusive
+    sorted_pods_pad = jnp.concatenate(
+        [sorted_pods, jnp.full((1,), P, jnp.int32)])
+    pos_in_class = jnp.arange(P, dtype=jnp.int32) - class_offset[
+        jnp.minimum(cls_safe[sorted_pods], SC - 1)]
+    pri_sorted = pods.priority[sorted_pods]
+    cls_sorted = jnp.minimum(cls_safe[sorted_pods], SC - 1)
+    sorted_valid = pods.valid[sorted_pods]
+
+    def body(carry: _WaveCarry) -> _WaveCarry:
+        state, cursor, placed, node_out, wave_out, waves = carry
+        remaining = class_total - cursor
+        active = classes.valid & (remaining > 0)
+
+        # next pending pod per class → tier selection
+        nxt = sorted_pods_pad[jnp.minimum(class_offset + cursor, P)]
+        nxt_ok = active & (nxt < P)
+        nxt_safe = jnp.minimum(nxt, P - 1)
+        # i32 min is the neutral element, not a magic sentinel: in_tier also
+        # requires nxt_ok, so even real INT32_MIN priorities tier correctly
+        nxt_pri = jnp.where(nxt_ok, pods.priority[nxt_safe], _I32_MIN)
+        nxt_cre = jnp.where(nxt_ok, pods.creation[nxt_safe], _I32_MAX)
+        tier = nxt_pri.max()
+        in_tier = nxt_ok & (nxt_pri == tier)
+
+        # length of the tier run per class (pods at exactly this priority
+        # remaining at/after the cursor)
+        tier_pod = (
+            sorted_valid & (pri_sorted == tier)
+            & (pos_in_class >= cursor[cls_sorted])
+        )
+        tier_cnt = (
+            jnp.zeros((SC,), jnp.int32).at[cls_sorted].add(
+                tier_pod.astype(jnp.int32))
+        )
+        r = jnp.where(in_tier, jnp.minimum(remaining, tier_cnt), 0)
+
+        mask, score = _class_mask_score(tables, cyc, state)
+        mask = mask & in_tier[:, None]
+        r = _escape_cap(tables, cyc, state, r)
+
+        # independent set over the interaction graph, queue-rank order:
+        # a class yields to any earlier-ranked in-tier class it interacts with
+        rank_key = jnp.lexsort((nxt_cre, -nxt_pri))          # [SC] perm
+        crank = jnp.zeros((SC,), jnp.int32).at[rank_key].set(
+            jnp.arange(SC, dtype=jnp.int32))
+        earlier = crank[None, :] < crank[:, None]            # [SC, SC]
+        blocked = (G & earlier & in_tier[None, :]).any(axis=1)
+        attempted = in_tier & ~blocked & (r > 0)
+        r = jnp.where(attempted, r, 0)
+
+        # per-class admission: top-r feasible nodes by score, domain quotas
+        order_n = jnp.argsort(-score, axis=1)                # [SC, N]
+        feas_sorted = jnp.take_along_axis(mask, order_n, axis=1)
+        allowed = _domain_quota_pass(
+            tables, cyc, state, mask, order_n, feas_sorted)
+        grank = jnp.cumsum(allowed.astype(jnp.int32), axis=1) - 1
+        adm_sorted = allowed & (grank < r[:, None])
+        A = jnp.zeros((SC, N), bool).at[
+            jnp.arange(SC)[:, None], order_n].set(adm_sorted)
+
+        # per-node cross-class resolution in queue-rank order
+        cord = rank_key                                       # [SC] perm
+        A_ord = A[cord]
+        req_ord = req_by_class[cord]                          # [SC, R]
+        add = jnp.where(A_ord[:, :, None], req_ord[:, None, :], 0)
+        cum_exc = jnp.cumsum(add, axis=0) - add               # [SC, N, R]
+        # earlier same-wave classes consume free space; the pod itself must
+        # fit per PodFitsResources semantics (zero scalar requests ignore
+        # that scalar's free — fit._fit, predicates.go:800-845)
+        free = nodes.alloc[None] - state.used[None] - cum_exc
+        fits = _fit(req_ord[:, None, :], free)
+        keep = A_ord & fits
+
+        ps_ord = classes.portset[cord]
+        psafe = jnp.maximum(ps_ord, 0)
+        has_p = (ps_ord >= 0)
+        pairw = tables.portsets.pair_words[psafe]             # [SC, PWp]
+        wildw = tables.portsets.wild_words[psafe]
+        tripw = tables.portsets.trip_words[psafe]
+        kp = (keep & has_p[:, None])[:, :, None]
+        scan_or = lambda W: lax.associative_scan(
+            jnp.bitwise_or, jnp.where(kp, W[:, None, :], 0), axis=0)
+        inc_p, inc_w, inc_t = scan_or(pairw), scan_or(wildw), scan_or(tripw)
+        shift = lambda M: jnp.concatenate(
+            [jnp.zeros_like(M[:1]), M[:-1]], axis=0)
+        exc_p, exc_w, exc_t = shift(inc_p), shift(inc_w), shift(inc_t)
+        conflict = (
+            ((wildw[:, None, :] & exc_p) != 0)
+            | ((pairw[:, None, :] & exc_w) != 0)
+            | ((tripw[:, None, :] & exc_t) != 0)
+        ).any(-1)
+        keep = keep & (~has_p[:, None] | ~conflict)
+
+        # committed port words (kept classes only)
+        kp2 = (keep & has_p[:, None])[:, :, None]
+        or_last = lambda W: lax.associative_scan(
+            jnp.bitwise_or, jnp.where(kp2, W[:, None, :], 0), axis=0)[-1]
+        orp, orw, ort = or_last(pairw), or_last(wildw), or_last(tripw)
+
+        A_final = jnp.zeros_like(A).at[cord].set(keep)
+        m = A_final.sum(axis=1).astype(jnp.int32)             # [SC]
+        total = m.sum()
+
+        # ---- commit ----
+        Ai = A_final.astype(jnp.int32)
+        used2 = state.used + jnp.einsum("cn,cr->nr", Ai, req_by_class)
+        CNT2 = state.CNT + cyc.TM.astype(jnp.int32) @ Ai
+        HOLD2 = state.HOLD + cyc.has_anti.T.astype(jnp.int32) @ Ai
+        state2 = AssignState(
+            used=used2,
+            ppa=state.ppa | orp, ppw=state.ppw | orw, ppt=state.ppt | ort,
+            CNT=CNT2, HOLD=HOLD2,
+        )
+
+        # ---- map admissions back to pods (rank among kept, score order) ----
+        sck = jnp.where(A_final, score, -jnp.inf)
+        ordk = jnp.argsort(-sck, axis=1)
+        kept_sorted = jnp.take_along_axis(A_final, ordk, axis=1)
+        rank_sorted = jnp.cumsum(kept_sorted.astype(jnp.int32), axis=1) - 1
+        rank = jnp.zeros((SC, N), jnp.int32).at[
+            jnp.arange(SC)[:, None], ordk].set(rank_sorted)
+        tgt = jnp.where(A_final, class_offset[:, None] + cursor[:, None] + rank,
+                        P)
+        pod_id = jnp.where(A_final, sorted_pods_pad[jnp.minimum(tgt, P)], P)
+        node_out2 = node_out.at[pod_id.reshape(-1)].set(
+            jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32)[None, :],
+                             (SC, N)).reshape(-1))
+        wave_out2 = wave_out.at[pod_id.reshape(-1)].set(waves)
+
+        # zero-progress ⇒ state is frozen ⇒ the whole tier run of every
+        # attempting class fails exactly as it would pod-by-pod in the scan
+        fail = total == 0
+        consume = jnp.where(fail & attempted,
+                            jnp.minimum(tier_cnt, remaining), m)
+        return _WaveCarry(
+            state=state2, cursor=cursor + consume, placed=placed + m,
+            node_out=node_out2, wave_out=wave_out2, waves=waves + 1,
+        )
+
+    cap = jnp.int32(max_waves if max_waves is not None else 2 * P + 2)
+
+    def cond(carry: _WaveCarry) -> Array:
+        remaining = (class_total - carry.cursor)
+        return ((remaining > 0) & tables.classes.valid).any() & (
+            carry.waves < cap)
+
+    init_carry = _WaveCarry(
+        state=init,
+        cursor=jnp.zeros((SC,), jnp.int32),
+        placed=jnp.zeros((SC,), jnp.int32),
+        node_out=jnp.full((P + 1,), -1, jnp.int32),
+        wave_out=jnp.full((P + 1,), -1, jnp.int32),
+        waves=jnp.int32(0),
+    )
+    final = lax.while_loop(cond, body, init_carry)
+    node = final.node_out[:P]
+    result = AssignResult(node=node, feasible=node >= 0, state=final.state)
+    if return_waves:
+        return result, final.wave_out[:P]
+    return result

@@ -40,18 +40,47 @@ def snapshot_with_keys(cache, encoder: Encoder, pending, base_dims):
     return snap, (uk, ev)
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
-def _schedule_batch(
+def _engine() -> str:
+    """Assignment engine: 'waves' (default — wave-parallel dense admission,
+    ops/waves.py) or 'scan' (the literal sequential-assume lax.scan,
+    ops/assign.py; KTPU_ASSIGN=scan) kept for debugging and as the
+    executable spec the wave path is tested against."""
+    import os
+
+    return os.environ.get("KTPU_ASSIGN", "waves")
+
+
+@functools.partial(jax.jit, static_argnums=(3, 5))
+def _schedule_batch_impl(
     tables: ClusterTables,
     pending: PodArrays,
     keys: Tuple[jnp.ndarray, jnp.ndarray],
     D: int,
     existing: PodArrays,
+    engine: str,
 ) -> AssignResult:
+    from ..ops.waves import assign_waves
+
     uk, ev = keys
     cyc = build_cycle(tables, existing, uk, ev, D)
     init = initial_state(tables, cyc)
-    return assign_batch(tables, cyc, pending, init)
+    if engine == "scan":
+        return assign_batch(tables, cyc, pending, init)
+    return assign_waves(tables, cyc, pending, init)
+
+
+def _schedule_batch(tables, pending, keys, D, existing,
+                    has_node_name: bool = False) -> AssignResult:
+    engine = _engine()
+    if engine != "scan" and has_node_name:
+        # spec.nodeName pods carry a per-POD (not per-class) host constraint
+        # the class-granular wave path cannot express; in the reference such
+        # pods bypass the scheduler entirely (kubelet consumes them), so a
+        # batch containing one is rare — route it through the literal scan.
+        # The flag comes from Dims (computed host-side at encode time) so the
+        # hot path never blocks on a device readback before dispatch.
+        engine = "scan"
+    return _schedule_batch_impl(tables, pending, keys, D, existing, engine)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -142,7 +171,7 @@ class BatchScheduler:
         ev = jnp.int32(enc.vocabs.label_vals.get(""))
         res = _schedule_batch(
             jax.device_put(tables), jax.device_put(pe), (uk, ev), d.D,
-            jax.device_put(ex),
+            jax.device_put(ex), has_node_name=d.has_node_name,
         )
         node_idx = jax.device_get(res.node)
 

@@ -216,7 +216,8 @@ class Scheduler:
         pending = [p for p, _ in batch]
         snap, keys = self._snapshot_keys(pending)
         res = _schedule_batch(snap.tables, snap.pending, keys, snap.dims.D,
-                              snap.existing)
+                              snap.existing,
+                              has_node_name=snap.dims.has_node_name)
         node_idx = jax.device_get(res.node)
 
         failures: List[Tuple[Pod, int]] = []

@@ -58,6 +58,9 @@ class Dims:
     NW: int = 1       # namespace bitset words (32 ns per word)
     PWp: int = 1      # (proto,port) pair bitset words
     PWt: int = 1      # (proto,port,ip) triple bitset words
+    # host-side facts about the encoded batch (not capacities): lets the
+    # dispatch layer pick an engine without a device round-trip
+    has_node_name: bool = False  # any pending pod sets spec.nodeName
 
     def grown_for(self, **mins: int) -> "Dims":
         """Return dims with each named capacity bucketed up to at least the

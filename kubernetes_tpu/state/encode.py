@@ -546,4 +546,7 @@ class Encoder:
         )
         ex = self.build_pod_arrays(existing, d, node_index, capacity=d.E)
         pe = self.build_pod_arrays(pending, d, node_index, capacity=d.P)
+        from dataclasses import replace
+
+        d = replace(d, has_node_name=bool((pe.node_name_req >= 0).any()))
         return tables, ex, pe, d
