@@ -147,42 +147,50 @@ def _domain_quota_pass(tables, cyc, state, mask, order_n, allowed_sorted):
         return ~active | (rank_in_dom < quota_d[dsafe])
 
     # --- hard topology-spread slots (only self-matching classes move their
-    # own counts; others are quota-free here and guarded by the graph) ---
-    for t in range(TS):
-        def spread_slot(c):
-            s_id = classes.tsc_term[c, t]
-            s = jnp.maximum(s_id, 0)
-            active = (
-                (s_id >= 0) & classes.tsc_hard[c, t] & cyc.TM[s, c]
-            )
-            eld = cyc.ELD[c, t, :D]
-            active = active & eld.any()
-            k = terms.topo_key[s]
-            dom = jnp.where((k >= 0) & nodes.valid,
-                            nodes.domain[:, jnp.maximum(k, 0)], -1)
-            seg = domain_agg(state.CNT[s][None], dom[None], D,
-                             eligible=cyc.static.node_match[c][None])[0]
-            min_cnt = jnp.min(jnp.where(eld, seg[:D], _I32_MAX))
-            quota = jnp.clip(
-                classes.tsc_maxskew[c, t] + min_cnt - seg, 0, _I32_MAX
-            )
-            quota = jnp.where(active, quota, _I32_MAX)
-            return slot_quota(c, s_id, k, active, quota)
+    # own counts; others are quota-free here and guarded by the graph).
+    # Slots are a vmapped axis, not a Python loop: the traced graph stays the
+    # same size no matter how many TS/AN slots the constraint schema needs. ---
+    def spread_slot(c, t):
+        s_id = classes.tsc_term[c, t]
+        s = jnp.maximum(s_id, 0)
+        active = (
+            (s_id >= 0) & classes.tsc_hard[c, t] & cyc.TM[s, c]
+        )
+        eld = cyc.ELD[c, t, :D]
+        active = active & eld.any()
+        k = terms.topo_key[s]
+        dom = jnp.where((k >= 0) & nodes.valid,
+                        nodes.domain[:, jnp.maximum(k, 0)], -1)
+        seg = domain_agg(state.CNT[s][None], dom[None], D,
+                         eligible=cyc.static.node_match[c][None])[0]
+        min_cnt = jnp.min(jnp.where(eld, seg[:D], _I32_MAX))
+        quota = jnp.clip(
+            classes.tsc_maxskew[c, t] + min_cnt - seg, 0, _I32_MAX
+        )
+        quota = jnp.where(active, quota, _I32_MAX)
+        return slot_quota(c, s_id, k, active, quota)
 
-        allowed_sorted = allowed_sorted & jax.vmap(spread_slot)(jnp.arange(SC))
+    rows = jax.vmap(
+        lambda c: jax.vmap(lambda t: spread_slot(c, t))(
+            jnp.arange(TS, dtype=jnp.int32))
+    )(jnp.arange(SC, dtype=jnp.int32))            # [SC, TS, N]
+    allowed_sorted = allowed_sorted & rows.all(axis=1)
 
     # --- self-matching anti-affinity slots: one per domain per wave ---
-    for t in range(AN):
-        def anti_slot(c):
-            s_id = classes.anti_terms[c, t]
-            s = jnp.maximum(s_id, 0)
-            k = terms.topo_key[s]
-            active = (s_id >= 0) & cyc.TM[s, c] & (k >= 0)
-            quota = jnp.where(active, jnp.ones((D + 1,), jnp.int32),
-                              _I32_MAX)
-            return slot_quota(c, s_id, k, active, quota)
+    def anti_slot(c, t):
+        s_id = classes.anti_terms[c, t]
+        s = jnp.maximum(s_id, 0)
+        k = terms.topo_key[s]
+        active = (s_id >= 0) & cyc.TM[s, c] & (k >= 0)
+        quota = jnp.where(active, jnp.ones((D + 1,), jnp.int32),
+                          _I32_MAX)
+        return slot_quota(c, s_id, k, active, quota)
 
-        allowed_sorted = allowed_sorted & jax.vmap(anti_slot)(jnp.arange(SC))
+    rows = jax.vmap(
+        lambda c: jax.vmap(lambda t: anti_slot(c, t))(
+            jnp.arange(AN, dtype=jnp.int32))
+    )(jnp.arange(SC, dtype=jnp.int32))            # [SC, AN, N]
+    allowed_sorted = allowed_sorted & rows.all(axis=1)
 
     return allowed_sorted
 

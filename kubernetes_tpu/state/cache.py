@@ -33,17 +33,38 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field, replace
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..api.types import Node, Pod
+from .arrays import ClusterTables, NodeArrays, PodArrays
 from .dims import Dims
 from .encode import Encoder
 
 
 DEFAULT_ASSUME_TTL = 30.0  # durationToExpireAssumedPod, scheduler.go:268 (30s)
+
+I32 = np.int32
+
+
+@jax.jit
+def _patch_rows(tree, idx, rows):
+    """Scatter `rows` (a pytree of [k, …] updates) into device `tree` at row
+    indices `idx` — the device half of the incremental snapshot
+    (cache.go:204-255's per-NodeInfo copy, as one fused dynamic-update)."""
+    return jax.tree.map(lambda a, r: a.at[idx].set(r), tree, rows)
+
+
+def _pad_patch(idx: List[int], k_bucket: int) -> np.ndarray:
+    """Pad the dirty-row index list to a bucketed length by repeating the
+    first index — the repeated .set of identical values is idempotent, and
+    bucketing keeps the patch kernel's compile count logarithmic."""
+    out = np.full((k_bucket,), idx[0], I32)
+    out[: len(idx)] = idx
+    return out
 
 
 @dataclass
@@ -84,12 +105,49 @@ class SchedulerCache:
     the reference's `cache.mu` discipline."""
 
     def __init__(self, ttl: float = DEFAULT_ASSUME_TTL) -> None:
-        self._mu = threading.Lock()
+        self._mu = threading.RLock()
         self._ttl = ttl
         self._nodes: Dict[str, Node] = {}
         self._pods: Dict[str, _PodState] = {}
         self._generation = 0
         self._snapshot: Optional[Snapshot] = None
+        # ---- incremental snapshot state (cache.go:204-255 analog) ----
+        # pods grouped by node: the unit of row re-encode is one node row
+        self._by_node: Dict[str, Dict[str, Pod]] = {}
+        self._dirty_nodes: Set[str] = set()           # rows to re-encode
+        self._dirty_pods: Dict[str, Optional[Pod]] = {}  # key → Pod | None(del)
+        # stable slot assignment: device row index per node / existing pod
+        self._node_slot: Dict[str, int] = {}
+        self._node_names: List[str] = []              # slot → name ("" freed)
+        self._free_node_slots: List[int] = []
+        self._pod_slot: Dict[str, int] = {}
+        self._pod_keys: List[str] = []                # slot → key ("" freed)
+        self._free_pod_slots: List[int] = []
+        # host numpy staging mirrors of the device arrays
+        self._staging_nodes: Optional[NodeArrays] = None
+        self._staging_pod_rows: Optional[np.ndarray] = None   # [E, 6] i32
+        self._staging_pod_valid: Optional[np.ndarray] = None  # [E] bool
+        self._staging_pod_node: Optional[np.ndarray] = None   # [E] i32
+        self._encoder: Optional[Encoder] = None
+        self._reg_sizes: Dict[str, int] = {}
+        self._n_topo_keys = 0
+        # introspection for tests/bench: how the last snapshot was produced
+        self.last_snapshot_mode: str = ""   # "cached" | "patch" | "full"
+        self.last_patch_rows: int = 0
+
+    # -- dirty-tracking helpers (callers hold self._mu) -- #
+
+    def _pod_placed(self, pod: Pod) -> None:
+        if pod.node_name:
+            self._by_node.setdefault(pod.node_name, {})[pod.key] = pod
+            self._dirty_nodes.add(pod.node_name)
+        self._dirty_pods[pod.key] = pod
+
+    def _pod_unplaced(self, pod: Pod) -> None:
+        if pod.node_name:
+            self._by_node.get(pod.node_name, {}).pop(pod.key, None)
+            self._dirty_nodes.add(pod.node_name)
+        self._dirty_pods[pod.key] = None
 
     # ------------------------------------------------------------------ #
     # pod lifecycle (cache.go:283-517)
@@ -103,6 +161,7 @@ class SchedulerCache:
                 raise CacheError(f"pod {key} is already in the cache")
             p = replace(pod, node_name=node_name)
             self._pods[key] = _PodState(pod=p, assumed=True)
+            self._pod_placed(p)
             self._generation += 1
 
     def finish_binding(self, key: str, now: float) -> None:
@@ -125,6 +184,7 @@ class SchedulerCache:
             if not st.assumed:
                 raise CacheError(f"pod {key} is bound, cannot forget")
             del self._pods[key]
+            self._pod_unplaced(st.pod)
             self._generation += 1
 
     def add_pod(self, pod: Pod) -> None:
@@ -136,11 +196,13 @@ class SchedulerCache:
             if st is not None and st.assumed:
                 # confirmation — possibly onto a different node than assumed
                 # (cache.go:404-410 logs and corrects)
+                self._pod_unplaced(st.pod)
                 self._pods[key] = _PodState(pod=pod)
             elif st is None:
                 self._pods[key] = _PodState(pod=pod)
             else:
                 raise CacheError(f"pod {key} was already added")
+            self._pod_placed(pod)
             self._generation += 1
 
     def update_pod(self, pod: Pod) -> None:
@@ -150,7 +212,9 @@ class SchedulerCache:
             st = self._pods.get(pod.key)
             if st is None or st.assumed:
                 raise CacheError(f"pod {pod.key} is not bound in the cache")
+            self._pod_unplaced(st.pod)
             st.pod = pod
+            self._pod_placed(pod)
             self._generation += 1
 
     def remove_pod(self, key: str) -> None:
@@ -160,6 +224,7 @@ class SchedulerCache:
             if st is None:
                 raise CacheError(f"pod {key} is not in the cache")
             del self._pods[key]
+            self._pod_unplaced(st.pod)
             self._generation += 1
 
     def is_assumed(self, key: str) -> bool:
@@ -179,11 +244,13 @@ class SchedulerCache:
     def add_node(self, node: Node) -> None:
         with self._mu:
             self._nodes[node.name] = node
+            self._dirty_nodes.add(node.name)
             self._generation += 1
 
     def update_node(self, node: Node) -> None:
         with self._mu:
             self._nodes[node.name] = node
+            self._dirty_nodes.add(node.name)
             self._generation += 1
 
     def remove_node(self, name: str) -> None:
@@ -191,6 +258,7 @@ class SchedulerCache:
             if name not in self._nodes:
                 raise CacheError(f"node {name} is not in the cache")
             del self._nodes[name]
+            self._dirty_nodes.add(name)
             self._generation += 1
 
     # ------------------------------------------------------------------ #
@@ -207,6 +275,7 @@ class SchedulerCache:
                 if st.assumed and st.binding_finished and st.deadline is not None \
                         and now >= st.deadline:
                     del self._pods[key]
+                    self._pod_unplaced(st.pod)
                     expired.append(key)
             if expired:
                 self._generation += 1
@@ -244,9 +313,11 @@ class SchedulerCache:
         base_dims: Optional[Dims] = None,
         extra_intern: Sequence[str] = (),
     ) -> Snapshot:
-        """UpdateNodeInfoSnapshot analog: return the cached encoded view if
-        neither the cluster state (generation) nor the pending set changed;
-        otherwise re-encode and transfer once.
+        """UpdateNodeInfoSnapshot analog (cache.go:204-255): return the cached
+        encoded view when nothing changed; re-encode ONLY the dirty node/pod
+        rows and scatter them into the resident device arrays when the change
+        fits the existing capacities; fall back to a full encode + transfer
+        only when a capacity (Dims) actually grows.
 
         The pending signature includes object identity, not just pod keys: a
         spec update flows through the queue as a *new* Pod object with the same
@@ -258,28 +329,303 @@ class SchedulerCache:
             snap = self._snapshot
             if snap is not None and snap.generation == gen \
                     and snap.pending_keys == pending_keys:
+                self.last_snapshot_mode = "cached"
                 return snap
-            nodes = list(self._nodes.values())
-            existing = [st.pod for st in self._pods.values()]
 
-        for s in extra_intern:
-            encoder.vocabs.label_keys.intern(s)
-        tables, ex, pe, d = encoder.encode_cluster(
-            nodes, existing, list(pending), base_dims
+            for s in extra_intern:
+                encoder.vocabs.label_keys.intern(s)
+            for p in pending:
+                encoder.pod_row(p)  # memoized: O(new pods), registers classes
+            if self._staging_nodes is None or self._encoder is not encoder:
+                for st in self._pods.values():   # cold: walk everything once
+                    encoder.pod_row(st.pod)
+            else:
+                for p in self._dirty_pods.values():
+                    if p is not None:
+                        encoder.pod_row(p)       # steady state: O(changed)
+            for name in self._dirty_nodes:
+                n = self._nodes.get(name)
+                if n is not None:
+                    encoder.intern_node(n)
+
+            # slot releases for removed nodes come FIRST so a same-window
+            # remove+add nets out instead of growing capacity; then slot
+            # allocation in node-insertion order so the lattice's node-index
+            # tie-breaks are a deterministic function of event order. Slots
+            # are decided here (not in the mutators) so they stay consistent
+            # with the staging arrays even when snapshots are skipped.
+            released_nodes: List[int] = []
+            for name in [nm for nm in self._dirty_nodes
+                         if nm not in self._nodes]:
+                slot = self._node_slot.pop(name, None)
+                if slot is None:
+                    continue
+                self._node_names[slot] = ""
+                self._free_node_slots.append(slot)
+                released_nodes.append(slot)
+                if self._staging_nodes is not None:
+                    for f in self._staging_nodes:
+                        f[slot] = False if f.dtype == bool else (
+                            0 if f.dtype == np.uint32 else -1)
+                    self._staging_nodes.alloc[slot] = 0
+                    self._staging_nodes.used[slot] = 0
+                    self._staging_nodes.label_ints[slot] = 0
+                # pods still bound to the vanished node must stop pointing at
+                # the freed slot (a later node may reuse it); re-row them
+                for key, p in self._by_node.get(name, {}).items():
+                    self._dirty_pods.setdefault(key, p)
+            for name in self._nodes:
+                if name in self._dirty_nodes and name not in self._node_slot:
+                    if self._free_node_slots:
+                        slot = self._free_node_slots.pop()
+                        self._node_names[slot] = name
+                    else:
+                        slot = len(self._node_names)
+                        self._node_names.append(name)
+                    self._node_slot[name] = slot
+                    # pods that bound to this node while it had no slot (watch
+                    # ordering / node re-add) carry node_id=-1 rows; re-row
+                    # them so counts and victim discovery see them again
+                    for key, p in self._by_node.get(name, {}).items():
+                        self._dirty_pods.setdefault(key, p)
+            pod_frees = len(self._free_pod_slots) + sum(
+                1 for k, p in self._dirty_pods.items()
+                if p is None and k in self._pod_slot)
+            new_pods = sum(1 for k, p in self._dirty_pods.items()
+                           if p is not None and k not in self._pod_slot)
+            n_pod_slots = len(self._pod_keys) + max(new_pods - pod_frees, 0)
+
+            d = encoder.dims(
+                len(self._node_names), n_pod_slots, len(pending),
+                list(self._nodes.values()),
+                # capacities are monotonic ACROSS cycles: seed from the live
+                # snapshot so a smaller pending batch doesn't shrink P and
+                # masquerade as a capacity change
+                snap.dims if snap is not None else base_dims,
+            )
+            # the engine-routing flag is per-batch, not a capacity: it must
+            # not force a full re-encode when it flips
+            d = replace(d, has_node_name=any(p.node_name for p in pending))
+
+            full = (
+                snap is None
+                or self._staging_nodes is None
+                or self._encoder is not encoder
+                or replace(d, has_node_name=False)
+                != replace(snap.dims, has_node_name=False)
+                # a new topology key adds a column to EVERY node row
+                or len(encoder.vocabs.topo_keys) != self._n_topo_keys
+            )
+            if full:
+                return self._full_snapshot(encoder, pending, pending_keys,
+                                           gen, d)
+            return self._patch_snapshot(encoder, pending, pending_keys,
+                                        gen, d, snap, released_nodes)
+
+    @staticmethod
+    def _registry_sizes(encoder: Encoder) -> Dict[str, int]:
+        return {
+            "reqs": len(encoder.req_reg),
+            "labelsets": len(encoder.labelset_reg),
+            "nterms": len(encoder.nterm_reg),
+            "tolsets": len(encoder.tolset_reg),
+            "portsets": len(encoder.portset_reg),
+            "terms": len(encoder.term_reg),
+            "classes": len(encoder.class_reg),
+        }
+
+    def _existing_pod_arrays(self, d: Dims) -> PodArrays:
+        rows = self._staging_pod_rows
+        return PodArrays(
+            valid=self._staging_pod_valid[: d.E],
+            name_id=rows[: d.E, 0], ns=rows[: d.E, 1], cls=rows[: d.E, 2],
+            priority=rows[: d.E, 3], creation=rows[: d.E, 4],
+            node_id=self._staging_pod_node[: d.E],
+            node_name_req=rows[: d.E, 5],
         )
+
+    def _full_snapshot(self, encoder, pending, pending_keys, gen, d) -> Snapshot:
+        """Cold path: rebuild staging + every device table. Runs when
+        capacities grow (recompile territory anyway) or on first use."""
+        self.last_snapshot_mode = "full"
+        # compact, stable slot assignment
+        live_nodes = [nm for nm in self._node_names if nm in self._nodes]
+        for nm in self._nodes:
+            if nm not in self._node_slot:
+                live_nodes.append(nm)
+        self._node_names = live_nodes
+        self._node_slot = {nm: i for i, nm in enumerate(live_nodes)}
+        self._free_node_slots = []
+        self._pod_keys = list(self._pods.keys())
+        self._pod_slot = {k: i for i, k in enumerate(self._pod_keys)}
+        self._free_pod_slots = []
+
+        nodes = [self._nodes[nm] for nm in self._node_names]
+        self._staging_nodes = encoder.empty_node_arrays(d)
+        for i, n in enumerate(nodes):
+            encoder.encode_node_row(
+                self._staging_nodes, i, n,
+                list(self._by_node.get(n.name, {}).values()), d)
+
+        self._staging_pod_rows = np.zeros((d.E, 6), I32)
+        self._staging_pod_rows[:, 0] = -1
+        self._staging_pod_rows[:, 1] = -1
+        self._staging_pod_rows[:, 5] = -1
+        self._staging_pod_valid = np.zeros((d.E,), bool)
+        self._staging_pod_node = np.full((d.E,), -1, I32)
+        for i, k in enumerate(self._pod_keys):
+            p = self._pods[k].pod
+            self._staging_pod_rows[i] = encoder.pod_row(p)
+            self._staging_pod_valid[i] = True
+            self._staging_pod_node[i] = self._node_slot.get(p.node_name, -1)
+
+        tables = ClusterTables(
+            nodes=self._staging_nodes,
+            reqs=encoder.build_req_table(d),
+            labelsets=encoder.build_labelset_table(d),
+            nterms=encoder.build_nterm_table(d),
+            tolsets=encoder.build_tolset_table(d),
+            portsets=encoder.build_portset_table(d),
+            terms=encoder.build_term_table(d),
+            classes=encoder.build_class_table(d),
+        )
+        pe = encoder.build_pod_arrays(list(pending), d, self._node_slot,
+                                      capacity=d.P)
         snap = Snapshot(
             generation=gen,
-            node_order=[n.name for n in nodes],
+            node_order=list(self._node_names),
             tables=jax.device_put(tables),
-            existing=jax.device_put(ex),
+            existing=jax.device_put(self._existing_pod_arrays(d)),
             pending=jax.device_put(pe),
             dims=d,
             pending_keys=pending_keys,
-            existing_keys=tuple(p.key for p in existing),
+            existing_keys=tuple(self._pod_keys),
         )
-        with self._mu:
-            self._snapshot = snap
+        self._encoder = encoder
+        self._reg_sizes = self._registry_sizes(encoder)
+        self._n_topo_keys = len(encoder.vocabs.topo_keys)
+        self._dirty_nodes.clear()
+        self._dirty_pods.clear()
+        self.last_patch_rows = len(self._node_names)
+        self._snapshot = snap
         return snap
+
+    def _patch_snapshot(self, encoder, pending, pending_keys, gen, d,
+                        snap: Snapshot,
+                        released_nodes: Sequence[int] = ()) -> Snapshot:
+        """Steady-state path: O(changed) host work, O(changed) device scatter.
+        This is what makes `state/encode.py`'s "patched incrementally" promise
+        true — no full re-encode, no full re-upload."""
+        self.last_snapshot_mode = "patch"
+        from .dims import bucket
+
+        # --- node rows (removed nodes were already cleared in snapshot()) ---
+        node_idx: List[int] = list(released_nodes)
+        for name in sorted(self._dirty_nodes):
+            n = self._nodes.get(name)
+            if n is None:
+                continue
+            slot = self._node_slot[name]
+            encoder.encode_node_row(
+                self._staging_nodes, slot, n,
+                list(self._by_node.get(name, {}).values()), d)
+            node_idx.append(slot)
+
+        tables = snap.tables
+        if node_idx:
+            kb = bucket(len(node_idx))
+            idx = _pad_patch(node_idx, kb)
+            rows = NodeArrays(*[np.ascontiguousarray(f[idx])
+                                for f in self._staging_nodes])
+            tables = tables._replace(
+                nodes=_patch_rows(tables.nodes, jnp.asarray(idx), rows))
+
+        # --- small interned tables: rebuild only the ones whose registry grew
+        sizes = self._registry_sizes(encoder)
+        if sizes != self._reg_sizes:
+            rebuilt = {}
+            if sizes["reqs"] != self._reg_sizes["reqs"]:
+                rebuilt["reqs"] = encoder.build_req_table(d)
+            if sizes["labelsets"] != self._reg_sizes["labelsets"]:
+                rebuilt["labelsets"] = encoder.build_labelset_table(d)
+            if sizes["nterms"] != self._reg_sizes["nterms"]:
+                rebuilt["nterms"] = encoder.build_nterm_table(d)
+            if sizes["tolsets"] != self._reg_sizes["tolsets"]:
+                rebuilt["tolsets"] = encoder.build_tolset_table(d)
+            if sizes["portsets"] != self._reg_sizes["portsets"]:
+                rebuilt["portsets"] = encoder.build_portset_table(d)
+            if sizes["terms"] != self._reg_sizes["terms"]:
+                rebuilt["terms"] = encoder.build_term_table(d)
+            if sizes["classes"] != self._reg_sizes["classes"]:
+                rebuilt["classes"] = encoder.build_class_table(d)
+            tables = tables._replace(
+                **{k: jax.device_put(v) for k, v in rebuilt.items()})
+            self._reg_sizes = sizes
+
+        # --- existing-pod rows: removals first so a same-window remove+add
+        # reuses the freed slot instead of growing past capacity ---
+        pod_idx: List[int] = []
+        for key in sorted(self._dirty_pods):
+            if self._dirty_pods[key] is not None:
+                continue
+            slot = self._pod_slot.pop(key, None)
+            if slot is None:
+                continue
+            self._pod_keys[slot] = ""
+            self._free_pod_slots.append(slot)
+            self._staging_pod_valid[slot] = False
+            self._staging_pod_rows[slot] = (-1, -1, 0, 0, 0, -1)
+            self._staging_pod_node[slot] = -1
+            pod_idx.append(slot)
+        for key in sorted(self._dirty_pods):
+            pod = self._dirty_pods[key]
+            if pod is None:
+                continue
+            slot = self._pod_slot.get(key)
+            if slot is None:
+                if self._free_pod_slots:
+                    slot = self._free_pod_slots.pop()
+                    self._pod_keys[slot] = key
+                else:
+                    slot = len(self._pod_keys)
+                    self._pod_keys.append(key)
+                self._pod_slot[key] = slot
+            self._staging_pod_rows[slot] = encoder.pod_row(pod)
+            self._staging_pod_valid[slot] = True
+            self._staging_pod_node[slot] = self._node_slot.get(
+                pod.node_name, -1)
+            pod_idx.append(slot)
+
+        existing = snap.existing
+        if pod_idx:
+            kb = bucket(len(pod_idx))
+            idx = _pad_patch(pod_idx, kb)
+            host = self._existing_pod_arrays(d)
+            rows = PodArrays(*[np.ascontiguousarray(f[idx]) for f in host])
+            existing = _patch_rows(existing, jnp.asarray(idx), rows)
+
+        # --- pending ---
+        if pending_keys == snap.pending_keys:
+            pe = snap.pending
+        else:
+            pe = jax.device_put(encoder.build_pod_arrays(
+                list(pending), d, self._node_slot, capacity=d.P))
+
+        new_snap = Snapshot(
+            generation=gen,
+            node_order=list(self._node_names),
+            tables=tables,
+            existing=existing,
+            pending=pe,
+            dims=d,
+            pending_keys=pending_keys,
+            existing_keys=tuple(self._pod_keys),
+        )
+        self._dirty_nodes.clear()
+        self._dirty_pods.clear()
+        self.last_patch_rows = len(node_idx) + len(pod_idx)
+        self._snapshot = new_snap
+        return new_snap
 
 
 class FakeCache(SchedulerCache):
@@ -291,7 +637,8 @@ class FakeCache(SchedulerCache):
             expired = [k for k, s in self._pods.items()
                        if s.assumed and s.binding_finished]
             for k in expired:
-                del self._pods[k]
+                st = self._pods.pop(k)
+                self._pod_unplaced(st.pod)
             if expired:
                 self._generation += 1
         return expired

@@ -12,13 +12,25 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 
 
-def bucket(n: int, minimum: int = 1) -> int:
-    """Round up to the next power of two (≥ minimum) so shape signatures are
-    stable as the cluster grows; one recompile per doubling."""
+def bucket(n: int, minimum: int = 1, align: int = 1) -> int:
+    """Round up to a coarse capacity bucket so shape signatures are stable as
+    the cluster grows. Small sizes (≤16) round to the next power of two; larger
+    sizes round to the next multiple of 2^(⌊log2 n⌋−3) — eight buckets per
+    octave, so padding waste is ≤12.5% (a pure power-of-two bucket wastes up to
+    ~100%: 5000 nodes would pad to 8192) while the number of distinct compile
+    signatures stays logarithmic. `align` forces the result to a multiple
+    (mesh sharding wants the node axis divisible by the device count)."""
     n = max(n, minimum)
-    p = 1
-    while p < n:
-        p <<= 1
+    if n <= 16:
+        p = 1
+        while p < n:
+            p <<= 1
+    else:
+        step = 1 << (max(n.bit_length() - 4, 0))
+        step = max(step, align)
+        p = ((n + step - 1) // step) * step
+    if align > 1 and p % align:
+        p = ((p + align - 1) // align) * align
     return p
 
 
@@ -64,11 +76,20 @@ class Dims:
 
     def grown_for(self, **mins: int) -> "Dims":
         """Return dims with each named capacity bucketed up to at least the
-        given minimum (never shrinks)."""
+        given minimum (never shrinks). The node axis stays a multiple of 8 so
+        an 8-device mesh shards it evenly.
+
+        E (existing pods) doubles instead of taking the fine 12.5% buckets:
+        it grows monotonically as pods bind, and every growth forces a full
+        re-encode + recompile, so amortized (power-of-two) headroom keeps the
+        steady state on the incremental patch path."""
         updates = {}
         for name, m in mins.items():
             cur = getattr(self, name)
-            need = bucket(m, 1)
+            if name == "E":
+                need = 1 << max(m - 1, 1).bit_length()
+            else:
+                need = bucket(m, 1, align=8 if name == "N" else 1)
             if need > cur:
                 updates[name] = need
         return replace(self, **updates) if updates else self

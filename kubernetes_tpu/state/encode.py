@@ -76,6 +76,18 @@ class Encoder:
         self.term_reg = Vocab()      # (sel req tuple, ns_id tuple, topo_key_id)
         self.class_reg = Vocab()     # the full pod-spec tuple
         self._class_spec: List[tuple] = []  # parallel to class_reg ids
+        # incremental-encode state (the cache.go:204-255 analog's host half):
+        # per-object memos so steady-state cycles do O(changed) interning work.
+        self._pod_rows: Dict[int, tuple] = {}   # id(pod) → (pod, row tuple)
+        self._node_seen: Dict[int, Node] = {}   # id(node) → node (interned)
+        # append-only compact domain index per topo key (node label value →
+        # dense domain id); persistent so device rows stay patchable
+        self.domain_maps: List[Dict[int, int]] = []
+        # monotonic capacity trackers (capacities never shrink, so running
+        # maxima replace O(N) rescans of the node set on every dims() call)
+        self._max_node_labels = 1
+        self._max_node_taints = 1
+        self._node_domains_done: Dict[int, tuple] = {}
 
     # ---------------- sub-object interning ---------------- #
 
@@ -186,6 +198,9 @@ class Encoder:
         return cid
 
     def intern_node(self, n: Node) -> None:
+        seen = self._node_seen.get(id(n))
+        if seen is n:
+            return
         self.vocabs.node_names.intern(n.name)
         for k, v in n.labels.items():
             self.vocabs.label_keys.intern(k)
@@ -195,6 +210,57 @@ class Encoder:
             self.vocabs.label_vals.intern(t.value)
         for name, _ in n.allocatable.scalars:
             self.vocabs.resources.intern(name)
+        self._max_node_labels = max(self._max_node_labels, len(n.labels))
+        self._max_node_taints = max(self._max_node_taints, len(n.taints))
+        if len(self._node_seen) > (1 << 21):
+            self._node_seen.clear()  # bound the memo (ids may now be reused)
+        self._node_seen[id(n)] = n
+
+    def pod_row(self, p: Pod) -> tuple:
+        """Interned identity row for one pod:
+        (name_id, ns_id, class_id, priority, creation, node_name_vocab_id).
+        Memoized by object identity (the keepalive reference makes id() safe),
+        so a pod is walked ONCE when it first appears — the analog of the
+        reference encoding a pod into NodeInfo once per informer event, not
+        once per cycle (cache.go:394)."""
+        ent = self._pod_rows.get(id(p))
+        if ent is not None and ent[0] is p:
+            return ent[1]
+        row = (
+            self.vocabs.pod_names.intern(p.name),
+            self.vocabs.namespaces.intern(p.namespace),
+            self.class_id(p),
+            p.priority,
+            p.creation_index,
+            self.vocabs.node_names.intern(p.node_name) if p.node_name else -1,
+        )
+        if len(self._pod_rows) > (1 << 21):
+            self._pod_rows.clear()  # bound the memo; cold re-walk is correct
+        self._pod_rows[id(p)] = (p, row)
+        return row
+
+    def register_node_domains(self, n: Node) -> None:
+        """Assign compact per-topology-key domain ids for this node's labels.
+        Append-only: ids are stable across encodes so device rows patch
+        in place. Memoized per (node object, topo-key count) so steady-state
+        cycles skip already-registered nodes in O(1)."""
+        v = self.vocabs
+        nk = len(v.topo_keys)
+        done = self._node_domains_done.get(id(n))
+        if done is not None and done[0] is n and done[1] == nk:
+            return
+        while len(self.domain_maps) < nk:
+            self.domain_maps.append({})
+        for ki in range(nk):
+            key = v.topo_keys.lookup(ki)
+            if key in n.labels:
+                vid = v.label_vals.intern(n.labels[key])
+                dm = self.domain_maps[ki]
+                if vid not in dm:
+                    dm[vid] = len(dm)
+        if len(self._node_domains_done) > (1 << 21):
+            self._node_domains_done.clear()
+        self._node_domains_done[id(n)] = (n, nk)
 
     # ---------------- capacity computation ---------------- #
 
@@ -223,17 +289,16 @@ class Encoder:
             [len(r[2]) for s in nterm_specs for r in s[0]]
             + [len(r[2]) for s in term_specs for r in s[0]]
         )
-        max_domains = 1
-        for ki in range(len(v.topo_keys)):
-            key = v.topo_keys.lookup(ki)
-            max_domains = max(
-                max_domains, len({n.labels[key] for n in nodes if key in n.labels})
-            )
+        # domain capacity from the persistent per-key maps (register_node_domains)
+        # — O(K), not an O(N·K) rescan of every node's labels per cycle
+        for n in nodes:
+            self.register_node_domains(n)
+        max_domains = mx([len(dm) for dm in self.domain_maps])
 
         return d.grown_for(
             N=n_nodes, P=max(n_pending, 1), E=max(n_existing, 1),
             R=NUM_FIXED_RES + len(v.resources),
-            L=mx([len(n.labels) for n in nodes]),
+            L=self._max_node_labels,
             PL=mx([len(s) for i in range(len(self.labelset_reg))
                    for s in [self.labelset_reg.lookup(i)]]),
             T=mx([len(s[5]) for s in self._class_spec]),
@@ -241,7 +306,7 @@ class Encoder:
             Q=max_q, V=max_v,
             F=mx([len(s[1]) for s in nterm_specs]),
             TL=mx([len(s) for s in tol_specs]),
-            TT=mx([len(n.taints) for n in nodes]),
+            TT=self._max_node_taints,
             PP=mx([len(s) for s in port_specs]),
             AT=mx([len(s[9]) for s in self._class_spec]),
             AN=mx([len(s[10]) for s in self._class_spec]),
@@ -400,90 +465,109 @@ class Encoder:
                 t["tsc_maxskew"][i, ti], t["tsc_hard"][i, ti] = skew, hard
         return PodClassTable(**t)
 
+    def encode_node_row(
+        self, arrays: NodeArrays, i: int, n: Node, pods_on_node: Sequence[Pod],
+        d: Dims,
+    ) -> None:
+        """Write ONE node's full row (labels/taints/topo/alloc + the usage
+        aggregate of its pods) into host staging `arrays` at slot `i`. The
+        per-node unit of both the cold full encode and the incremental patch
+        (cache.go:204-255 copies NodeInfos one at a time for the same reason).
+        Pod usage comes from the interned class registry (pod_row), so the pod
+        object graph is walked at most once per object, not once per cycle."""
+        v = self.vocabs
+        arrays.valid[i] = True
+        arrays.name_id[i] = v.node_names.intern(n.name)
+        av = arrays.alloc[i]
+        av[:] = 0
+        av[0], av[1], av[2] = (n.allocatable.milli_cpu,
+                               n.allocatable.memory_kib,
+                               n.allocatable.ephemeral_kib)
+        av[RES_PODS] = n.allocatable.pods
+        for name, amt in n.allocatable.scalars:
+            av[NUM_FIXED_RES + v.resources.intern(name)] = amt
+        arrays.unschedulable[i] = n.unschedulable
+        arrays.label_keys[i] = -1
+        arrays.label_vals[i] = -1
+        arrays.label_ints[i] = 0
+        for li, (k, val) in enumerate(n.labels.items()):
+            arrays.label_keys[i, li] = v.label_keys.intern(k)
+            arrays.label_vals[i, li] = v.label_vals.intern(val)
+            arrays.label_ints[i, li] = parse_label_int(val)
+        arrays.taint_keys[i] = -1
+        arrays.taint_vals[i] = -1
+        arrays.taint_effects[i] = -1
+        for ti, t in enumerate(n.taints):
+            arrays.taint_keys[i, ti] = v.label_keys.intern(t.key)
+            arrays.taint_vals[i, ti] = v.label_vals.intern(t.value)
+            arrays.taint_effects[i, ti] = int(t.effect)
+        self.register_node_domains(n)
+        arrays.topo[i] = -1
+        arrays.domain[i] = -1
+        for ki in range(len(v.topo_keys)):
+            key = v.topo_keys.lookup(ki)
+            if key in n.labels:
+                vid = v.label_vals.intern(n.labels[key])
+                arrays.topo[i, ki] = vid
+                arrays.domain[i, ki] = self.domain_maps[ki][vid]
+
+        used = arrays.used[i]
+        used[:] = 0
+        arrays.port_pair_any[i] = 0
+        arrays.port_pair_wild[i] = 0
+        arrays.port_triple[i] = 0
+        for p in pods_on_node:
+            spec = self._class_spec[self.pod_row(p)[2]]
+            cpu, mem, eph, scalars = self.req_reg.lookup(spec[1])
+            used[0] += cpu
+            used[1] += mem
+            used[2] += eph
+            used[RES_PODS] += 1
+            for sid, amt in scalars:
+                used[NUM_FIXED_RES + sid] += amt
+            ports_id = spec[8]
+            if ports_id >= 0:
+                for pair, trip, wild in self.portset_reg.lookup(ports_id):
+                    _set_bit(arrays.port_pair_any[i], pair)
+                    if wild:
+                        _set_bit(arrays.port_pair_wild[i], pair)
+                    elif trip >= 0:
+                        _set_bit(arrays.port_triple[i], trip)
+
+    @staticmethod
+    def empty_node_arrays(d: Dims) -> NodeArrays:
+        """Host (numpy) staging NodeArrays, all slots invalid."""
+        N, R, L, TT, K = d.N, d.R, d.L, d.TT, d.K
+        return NodeArrays(
+            valid=np.zeros((N,), bool),
+            name_id=np.full((N,), -1, I32),
+            alloc=np.zeros((N, R), I32),
+            used=np.zeros((N, R), I32),
+            label_keys=np.full((N, L), -1, I32),
+            label_vals=np.full((N, L), -1, I32),
+            label_ints=np.zeros((N, L), I32),
+            unschedulable=np.zeros((N,), bool),
+            taint_keys=np.full((N, TT), -1, I32),
+            taint_vals=np.full((N, TT), -1, I32),
+            taint_effects=np.full((N, TT), -1, I32),
+            topo=np.full((N, K), -1, I32),
+            domain=np.full((N, K), -1, I32),
+            port_pair_any=np.zeros((N, d.PWp), U32),
+            port_pair_wild=np.zeros((N, d.PWp), U32),
+            port_triple=np.zeros((N, d.PWt), U32),
+        )
+
     def build_node_arrays(
         self, nodes: Sequence[Node], existing: Sequence[Pod], d: Dims
     ) -> NodeArrays:
-        N, R, L, TT, K = d.N, d.R, d.L, d.TT, d.K
-        v = self.vocabs
-        valid = np.zeros((N,), bool)
-        name_id = np.full((N,), -1, I32)
-        alloc = np.zeros((N, R), I32)
-        used = np.zeros((N, R), I32)
-        label_keys = np.full((N, L), -1, I32)
-        label_vals = np.full((N, L), -1, I32)
-        label_ints = np.zeros((N, L), I32)
-        unsched = np.zeros((N,), bool)
-        taint_keys = np.full((N, TT), -1, I32)
-        taint_vals = np.full((N, TT), -1, I32)
-        taint_effects = np.full((N, TT), -1, I32)
-        topo = np.full((N, K), -1, I32)
-        domain = np.full((N, K), -1, I32)
-        ppa = np.zeros((N, d.PWp), U32)
-        ppw = np.zeros((N, d.PWp), U32)
-        ppt = np.zeros((N, d.PWt), U32)
-
-        node_index = {n.name: i for i, n in enumerate(nodes)}
-        domain_maps: List[Dict[int, int]] = [dict() for _ in range(K)]
-
-        for i, n in enumerate(nodes):
-            valid[i] = True
-            name_id[i] = v.node_names.intern(n.name)
-            av = np.zeros((R,), I32)
-            av[0], av[1], av[2] = (n.allocatable.milli_cpu,
-                                   n.allocatable.memory_kib,
-                                   n.allocatable.ephemeral_kib)
-            av[RES_PODS] = n.allocatable.pods
-            for name, amt in n.allocatable.scalars:
-                av[NUM_FIXED_RES + v.resources.intern(name)] = amt
-            alloc[i] = av
-            unsched[i] = n.unschedulable
-            for li, (k, val) in enumerate(n.labels.items()):
-                label_keys[i, li] = v.label_keys.intern(k)
-                label_vals[i, li] = v.label_vals.intern(val)
-                label_ints[i, li] = parse_label_int(val)
-            for ti, t in enumerate(n.taints):
-                taint_keys[i, ti] = v.label_keys.intern(t.key)
-                taint_vals[i, ti] = v.label_vals.intern(t.value)
-                taint_effects[i, ti] = int(t.effect)
-            for ki in range(len(v.topo_keys)):
-                key = v.topo_keys.lookup(ki)
-                if key in n.labels:
-                    vid = v.label_vals.intern(n.labels[key])
-                    topo[i, ki] = vid
-                    dm = domain_maps[ki]
-                    if vid not in dm:
-                        dm[vid] = len(dm)
-                    domain[i, ki] = dm[vid]
-
+        arrays = self.empty_node_arrays(d)
+        by_node: Dict[str, List[Pod]] = {}
         for p in existing:
-            ni = node_index.get(p.node_name, -1)
-            if ni < 0:
-                continue
-            rid = self.req_id(p.requests)
-            cpu, mem, eph, scalars = self.req_reg.lookup(rid)
-            used[ni, 0] += cpu
-            used[ni, 1] += mem
-            used[ni, 2] += eph
-            used[ni, RES_PODS] += 1
-            for sid, amt in scalars:
-                used[ni, NUM_FIXED_RES + sid] += amt
-            for hp in p.host_ports:
-                if hp.port == 0:
-                    continue
-                pair = v.port_pairs.intern((hp.protocol, hp.port))
-                _set_bit(ppa[ni], pair)
-                if hp.host_ip in ("", "0.0.0.0"):
-                    _set_bit(ppw[ni], pair)
-                else:
-                    _set_bit(ppt[ni], v.port_triples.intern((hp.protocol, hp.port, hp.host_ip)))
-
-        return NodeArrays(
-            valid=valid, name_id=name_id, alloc=alloc, used=used,
-            label_keys=label_keys, label_vals=label_vals, label_ints=label_ints,
-            unschedulable=unsched, taint_keys=taint_keys, taint_vals=taint_vals,
-            taint_effects=taint_effects, topo=topo, domain=domain,
-            port_pair_any=ppa, port_pair_wild=ppw, port_triple=ppt,
-        )
+            if p.node_name:
+                by_node.setdefault(p.node_name, []).append(p)
+        for i, n in enumerate(nodes):
+            self.encode_node_row(arrays, i, n, by_node.get(n.name, ()), d)
+        return arrays
 
     def build_pod_arrays(
         self,
@@ -494,28 +578,25 @@ class Encoder:
     ) -> PodArrays:
         P = capacity if capacity is not None else max(len(pods), 1)
         node_index = node_index or {}
-        v = self.vocabs
+        k = len(pods)
         valid = np.zeros((P,), bool)
-        name_id = np.full((P,), -1, I32)
-        ns = np.full((P,), -1, I32)
-        cls = np.zeros((P,), I32)
-        priority = np.zeros((P,), I32)
-        creation = np.zeros((P,), I32)
         node_id = np.full((P,), -1, I32)
-        node_name_req = np.full((P,), -1, I32)
-        for i, p in enumerate(pods):
-            valid[i] = True
-            name_id[i] = v.pod_names.intern(p.name)
-            ns[i] = v.namespaces.intern(p.namespace)
-            cls[i] = self.class_id(p)
-            priority[i] = p.priority
-            creation[i] = p.creation_index
-            if p.node_name:
-                node_name_req[i] = v.node_names.intern(p.node_name)
-                node_id[i] = node_index.get(p.node_name, -1)
-        return PodArrays(valid=valid, name_id=name_id, ns=ns, cls=cls,
-                         priority=priority, creation=creation,
-                         node_id=node_id, node_name_req=node_name_req)
+        rows = np.zeros((P, 6), I32)
+        rows[:, 0] = rows[:, 1] = rows[:, 5] = -1  # absent ids, like before
+        if k:
+            # one vectorized assembly from memoized rows — 50k pods cost one
+            # numpy copy, not 50k spec walks (pod_row pays the walk exactly
+            # once per pod object, at informer-arrival time in steady state)
+            rows[:k] = np.array([self.pod_row(p) for p in pods], I32)
+            valid[:k] = True
+            nid = [node_index.get(p.node_name, -1) if p.node_name else -1
+                   for p in pods]
+            node_id[:k] = np.array(nid, I32)
+        return PodArrays(
+            valid=valid, name_id=rows[:, 0], ns=rows[:, 1], cls=rows[:, 2],
+            priority=rows[:, 3], creation=rows[:, 4],
+            node_id=node_id, node_name_req=rows[:, 5],
+        )
 
     # ---------------- one-shot full encode ---------------- #
 
@@ -531,7 +612,7 @@ class Encoder:
         for n in nodes:
             self.intern_node(n)
         for p in list(existing) + list(pending):
-            self.class_id(p)
+            self.pod_row(p)
         d = self.dims(len(nodes), len(existing), len(pending), nodes, base)
         node_index = {n.name: i for i, n in enumerate(nodes)}
         tables = ClusterTables(

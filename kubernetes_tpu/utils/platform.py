@@ -43,6 +43,33 @@ def cpu_disarmed_env(env: dict | None = None) -> dict:
     return out
 
 
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Point jax at a persistent on-disk compilation cache so a process restart
+    does not re-pay XLA compile time for shapes it has already seen (the 5k×50k
+    lattice costs ~2 min to compile cold). The reference has no analog — Go
+    compiles ahead of time — so this is pure TPU-runtime plumbing.
+
+    KTPU_COMPILE_CACHE=0 disables; KTPU_COMPILE_CACHE=<dir> overrides the
+    location (default: <repo>/.cache/xla). Returns the directory or None.
+    Safe to call any number of times, before or after jax import."""
+    env = os.environ.get("KTPU_COMPILE_CACHE", "")
+    if env == "0":
+        return None
+    d = path or env or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".cache", "xla")
+    try:
+        os.makedirs(d, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", d)
+        # cache every compile that takes noticeable time, not just >1s ones
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        return d
+    except Exception:
+        return None  # cache is an optimization; never fail the caller
+
+
 def _original_args() -> list[str]:
     """Interpreter args of THIS process, faithfully enough to re-exec.
 

@@ -35,6 +35,9 @@ def _needs_reexec() -> bool:
 
 def pytest_configure(config):
     if not _needs_reexec():
+        from kubernetes_tpu.utils.platform import enable_compile_cache
+
+        enable_compile_cache()
         return
     capman = config.pluginmanager.getplugin("capturemanager")
     if capman is not None:
