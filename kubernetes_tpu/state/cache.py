@@ -418,7 +418,7 @@ class SchedulerCache:
             )
             if full:
                 return self._full_snapshot(encoder, pending, pending_keys,
-                                           gen, d)
+                                           gen, d, base_dims)
             return self._patch_snapshot(encoder, pending, pending_keys,
                                         gen, d, snap, released_nodes)
 
@@ -444,7 +444,8 @@ class SchedulerCache:
             node_name_req=rows[: d.E, 5],
         )
 
-    def _full_snapshot(self, encoder, pending, pending_keys, gen, d) -> Snapshot:
+    def _full_snapshot(self, encoder, pending, pending_keys, gen, d,
+                       base_dims: Optional[Dims] = None) -> Snapshot:
         """Cold path: rebuild staging + every device table. Runs when
         capacities grow (recompile territory anyway) or on first use."""
         self.last_snapshot_mode = "full"
@@ -461,6 +462,16 @@ class SchedulerCache:
         self._free_pod_slots = []
 
         nodes = [self._nodes[nm] for nm in self._node_names]
+        # full re-encode rebuilds every row anyway — the free moment to
+        # compact churn-accumulated domain ids (hostname-keyed spread makes
+        # every node name ever seen a domain otherwise) and shrink D back
+        from .dims import bucket
+        encoder.rebuild_domain_maps(nodes)
+        max_dom = max((len(dm) for dm in encoder.domain_maps), default=1)
+        floor_d = (base_dims.D if base_dims is not None else Dims().D)
+        new_D = max(bucket(max_dom), floor_d)
+        if new_D < d.D:
+            d = replace(d, D=new_D)
         self._staging_nodes = encoder.empty_node_arrays(d)
         for i, n in enumerate(nodes):
             encoder.encode_node_row(
@@ -543,23 +554,19 @@ class SchedulerCache:
         # --- small interned tables: rebuild only the ones whose registry grew
         sizes = self._registry_sizes(encoder)
         if sizes != self._reg_sizes:
-            rebuilt = {}
-            if sizes["reqs"] != self._reg_sizes["reqs"]:
-                rebuilt["reqs"] = encoder.build_req_table(d)
-            if sizes["labelsets"] != self._reg_sizes["labelsets"]:
-                rebuilt["labelsets"] = encoder.build_labelset_table(d)
-            if sizes["nterms"] != self._reg_sizes["nterms"]:
-                rebuilt["nterms"] = encoder.build_nterm_table(d)
-            if sizes["tolsets"] != self._reg_sizes["tolsets"]:
-                rebuilt["tolsets"] = encoder.build_tolset_table(d)
-            if sizes["portsets"] != self._reg_sizes["portsets"]:
-                rebuilt["portsets"] = encoder.build_portset_table(d)
-            if sizes["terms"] != self._reg_sizes["terms"]:
-                rebuilt["terms"] = encoder.build_term_table(d)
-            if sizes["classes"] != self._reg_sizes["classes"]:
-                rebuilt["classes"] = encoder.build_class_table(d)
-            tables = tables._replace(
-                **{k: jax.device_put(v) for k, v in rebuilt.items()})
+            builders = {
+                "reqs": encoder.build_req_table,
+                "labelsets": encoder.build_labelset_table,
+                "nterms": encoder.build_nterm_table,
+                "tolsets": encoder.build_tolset_table,
+                "portsets": encoder.build_portset_table,
+                "terms": encoder.build_term_table,
+                "classes": encoder.build_class_table,
+            }
+            tables = tables._replace(**{
+                k: jax.device_put(builders[k](d))
+                for k in builders if sizes[k] != self._reg_sizes[k]
+            })
             self._reg_sizes = sizes
 
         # --- existing-pod rows: removals first so a same-window remove+add

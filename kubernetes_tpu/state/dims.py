@@ -18,8 +18,7 @@ def bucket(n: int, minimum: int = 1, align: int = 1) -> int:
     sizes round to the next multiple of 2^(⌊log2 n⌋−3) — eight buckets per
     octave, so padding waste is ≤12.5% (a pure power-of-two bucket wastes up to
     ~100%: 5000 nodes would pad to 8192) while the number of distinct compile
-    signatures stays logarithmic. `align` forces the result to a multiple
-    (mesh sharding wants the node axis divisible by the device count)."""
+    signatures stays logarithmic. `align` forces the result to a multiple."""
     n = max(n, minimum)
     if n <= 16:
         p = 1
@@ -88,8 +87,13 @@ class Dims:
             cur = getattr(self, name)
             if name == "E":
                 need = 1 << max(m - 1, 1).bit_length()
+            elif name == "N" and m <= 256:
+                # small node axes stay power-of-two: waste is negligible and
+                # divisibility by any pow2 mesh size is guaranteed (above 256
+                # the fine bucket's step is already a multiple of 32)
+                need = 1 << max(m - 1, 1).bit_length()
             else:
-                need = bucket(m, 1, align=8 if name == "N" else 1)
+                need = bucket(m, 1)
             if need > cur:
                 updates[name] = need
         return replace(self, **updates) if updates else self

@@ -53,6 +53,15 @@ def _set_bit(words: np.ndarray, idx: int) -> None:
     words[idx >> 5] |= U32(1) << U32(idx & 31)
 
 
+def _evict_half(memo: Dict, cap: int) -> None:
+    """Bound an id-keyed memo: drop the OLDEST half (dict preserves insertion
+    order) instead of clearing wholesale, so a long-running process never
+    pays a full cold re-walk spike and dead objects don't pile up forever."""
+    if len(memo) > cap:
+        for k in list(memo.keys())[: cap // 2]:
+            del memo[k]
+
+
 def nsel_as_term(node_selector: Dict[str, str]) -> NodeSelectorTerm:
     """spec.nodeSelector lowered to an AND-of-IN node term
     (predicates.go:879-886 uses labels.SelectorFromSet — equality match)."""
@@ -212,8 +221,7 @@ class Encoder:
             self.vocabs.resources.intern(name)
         self._max_node_labels = max(self._max_node_labels, len(n.labels))
         self._max_node_taints = max(self._max_node_taints, len(n.taints))
-        if len(self._node_seen) > (1 << 21):
-            self._node_seen.clear()  # bound the memo (ids may now be reused)
+        _evict_half(self._node_seen, 1 << 18)
         self._node_seen[id(n)] = n
 
     def pod_row(self, p: Pod) -> tuple:
@@ -234,10 +242,22 @@ class Encoder:
             p.creation_index,
             self.vocabs.node_names.intern(p.node_name) if p.node_name else -1,
         )
-        if len(self._pod_rows) > (1 << 21):
-            self._pod_rows.clear()  # bound the memo; cold re-walk is correct
+        _evict_half(self._pod_rows, 1 << 19)
         self._pod_rows[id(p)] = (p, row)
         return row
+
+    def rebuild_domain_maps(self, nodes: Sequence[Node]) -> None:
+        """Compact the per-topology-key domain maps to the LIVE node set.
+        Append-only ids are what make device rows patchable BETWEEN full
+        encodes, but without compaction node churn (hostname-keyed spread
+        makes every node name a domain) grows D forever; a full re-encode
+        rebuilds every row anyway, so it is the free moment to shrink.
+        NOTE: an Encoder is owned by one SchedulerCache — compaction
+        invalidates any other consumer's staged domain ids."""
+        self.domain_maps = [dict() for _ in range(len(self.vocabs.topo_keys))]
+        self._node_domains_done.clear()
+        for n in nodes:
+            self.register_node_domains(n)
 
     def register_node_domains(self, n: Node) -> None:
         """Assign compact per-topology-key domain ids for this node's labels.
@@ -258,8 +278,7 @@ class Encoder:
                 dm = self.domain_maps[ki]
                 if vid not in dm:
                     dm[vid] = len(dm)
-        if len(self._node_domains_done) > (1 << 21):
-            self._node_domains_done.clear()
+        _evict_half(self._node_domains_done, 1 << 18)
         self._node_domains_done[id(n)] = (n, nk)
 
     # ---------------- capacity computation ---------------- #

@@ -187,6 +187,27 @@ def test_capacity_growth_falls_back_to_full():
     assert got == oracle_names(cache, pending)
 
 
+def test_node_churn_does_not_grow_domains_forever():
+    """Hostname-keyed constraints make every node name a domain id. Node
+    replacement churn must not ratchet the D capacity up forever: each full
+    re-encode compacts the domain maps to the live node set."""
+    cache, enc = build_cache(n_nodes=8, n_bound=4)  # anti pods → hostname key
+    pending = [mkpod("p0", app="g0", anti=True, creation=100)]
+    schedule_names(cache, enc, pending)
+    for gen in range(6):  # 6 generations of full node replacement
+        for n in list(cache.nodes()):
+            if n.name.startswith(("n", f"gen{gen - 1}-")):
+                cache.remove_node(n.name)
+        for i in range(8):
+            cache.add_node(mknode(f"gen{gen}-{i}", zone=f"z{i % 3}"))
+        schedule_names(cache, enc, pending)
+    live_hostnames = len(cache.nodes())
+    assert live_hostnames == 8
+    # 48 distinct hostnames ever seen; D must track the ~8 live ones
+    assert cache._snapshot.dims.D <= 16, cache._snapshot.dims.D
+    assert schedule_names(cache, enc, pending) == oracle_names(cache, pending)
+
+
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 def test_randomized_churn_replay_matches_oracle(seed):
     """Property: after ANY sequence of cache mutations, scheduling through the

@@ -17,10 +17,13 @@ import pytest
 from kubernetes_tpu.models.workloads import flagship_pods, make_nodes
 from kubernetes_tpu.ops.assign import assign_batch, feasible_matrix, initial_state
 from kubernetes_tpu.ops.lattice import build_cycle
+from kubernetes_tpu.ops.waves import assign_waves
 from kubernetes_tpu.parallel.mesh import make_mesh, replicate, shard_tables
 from kubernetes_tpu.sched.cycle import UNSCHEDULABLE_TAINT_KEY
 from kubernetes_tpu.state.dims import Dims
 from kubernetes_tpu.state.encode import Encoder
+
+ENGINES = {"scan": assign_batch, "waves": assign_waves}
 
 
 def _encode(n_nodes, n_pods):
@@ -35,10 +38,10 @@ def _encode(n_nodes, n_pods):
     return tables, pe, ex, uk, ev, d
 
 
-def _cycle(tables, pending, existing, uk, ev, D):
+def _cycle(tables, pending, existing, uk, ev, D, engine):
     cyc = build_cycle(tables, existing, uk, ev, D)
     init = initial_state(tables, cyc)
-    res = assign_batch(tables, cyc, pending, init)
+    res = ENGINES[engine](tables, cyc, pending, init)
     feas = feasible_matrix(tables, cyc, pending)
     return res.node, res.feasible, res.state.used, feas
 
@@ -53,11 +56,14 @@ def test_mesh_requires_enough_devices():
         make_mesh(len(jax.devices()) + 1)
 
 
-def test_sharded_cycle_matches_unsharded(cluster):
+@pytest.mark.parametrize("engine", ["waves", "scan"])
+def test_sharded_cycle_matches_unsharded(cluster, engine):
+    """Both engines — `waves` (the production default) and `scan` (the
+    executable spec) — must be bit-identical sharded vs unsharded."""
     tables, pending, existing, uk, ev, d = cluster
     D = d.D
 
-    fn = jax.jit(lambda t, p, e, u, v: _cycle(t, p, e, u, v, D))
+    fn = jax.jit(lambda t, p, e, u, v: _cycle(t, p, e, u, v, D, engine))
 
     # unsharded (single-device) reference run
     ref_node, ref_feas, ref_used, ref_mat = jax.tree.map(
