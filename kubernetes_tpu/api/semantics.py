@@ -396,3 +396,206 @@ def node_affinity_score(pod: Pod, node: Node) -> int:
         if node_selector_term_matches(pref.term, node):
             total += pref.weight
     return total
+
+
+# --------------------------------------------------------------------------- #
+# Score parity set (priorities/) — pure-Python references for the tensor
+# kernels in ops/scores.py; golden-tested in tests/test_scores.py
+# --------------------------------------------------------------------------- #
+
+DEFAULT_HARD_POD_AFFINITY_WEIGHT = 1
+IMG_MIN_KIB = 23 * 1024
+IMG_MAX_KIB = 1000 * 1024
+ZONE_WEIGHTING = 2.0 / 3.0
+ZONE_LABELS = ("topology.kubernetes.io/zone",
+               "failure-domain.beta.kubernetes.io/zone")
+
+
+def _same_domain(a: Node, b: Node, key: str) -> bool:
+    return key in a.labels and key in b.labels and a.labels[key] == b.labels[key]
+
+
+def interpod_preferred_raw(
+    pod: Pod,
+    node: Node,
+    nodes_by_name: Dict[str, Node],
+    existing: Sequence[Pod],
+    hard_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT,
+) -> float:
+    """Raw (un-normalized) preferred inter-pod affinity count for one candidate
+    node — all four directions of interpod_affinity.go:119-215:
+      + pod's preferred terms matching existing pods in-domain,
+      − pod's preferred anti terms,
+      + existing pods' REQUIRED affinity terms matching the pod × hard_weight,
+      + existing pods' preferred terms matching the pod,
+      − existing pods' preferred anti terms matching the pod."""
+    raw = 0.0
+    for ex in existing:
+        exn = nodes_by_name.get(ex.node_name)
+        if exn is None:
+            continue
+        for w in pod.affinity.pod_preferred:
+            if term_matches_pod(w.term, pod, ex) and _same_domain(
+                    node, exn, w.term.topology_key):
+                raw += w.weight
+        for w in pod.affinity.anti_preferred:
+            if term_matches_pod(w.term, pod, ex) and _same_domain(
+                    node, exn, w.term.topology_key):
+                raw -= w.weight
+        for term in ex.affinity.pod_required:
+            if term_matches_pod(term, ex, pod) and _same_domain(
+                    node, exn, term.topology_key):
+                raw += hard_weight
+        for w in ex.affinity.pod_preferred:
+            if term_matches_pod(w.term, ex, pod) and _same_domain(
+                    node, exn, w.term.topology_key):
+                raw += w.weight
+        for w in ex.affinity.anti_preferred:
+            if term_matches_pod(w.term, ex, pod) and _same_domain(
+                    node, exn, w.term.topology_key):
+                raw -= w.weight
+    return raw
+
+
+def interpod_preferred_scores(
+    pod: Pod, nodes: Sequence[Node], existing: Sequence[Pod],
+    hard_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT,
+) -> Dict[str, float]:
+    """Min-max normalized 0..100 over all nodes (ops/interpod.py convention:
+    the normalization domain is every valid node; constant raw ⇒ 0)."""
+    by_name = {n.name: n for n in nodes}
+    raw = {n.name: interpod_preferred_raw(pod, n, by_name, existing,
+                                          hard_weight) for n in nodes}
+    lo, hi = min(raw.values()), max(raw.values())
+    if hi <= lo:
+        return {n.name: 0.0 for n in nodes}
+    return {k: 100.0 * (v - lo) / (hi - lo) for k, v in raw.items()}
+
+
+def even_spread_soft_scores(
+    pod: Pod, nodes: Sequence[Node], existing: Sequence[Pod]
+) -> Dict[str, float]:
+    """EvenPodsSpread SCORE over ScheduleAnyway constraints
+    (even_pods_spread.go:106-227), normalization domain = all eligible nodes
+    (docs/PARITY.md)."""
+    soft = [c for c in pod.topology_spread
+            if int(c.when_unsatisfiable) != 0]
+    out = {n.name: 0.0 for n in nodes}
+    if not soft:
+        return out
+
+    def node_matchable(n: Node) -> bool:
+        return pod_matches_node_selector(pod, n)
+
+    def elig(n: Node) -> bool:
+        return node_matchable(n) and all(
+            c.topology_key in n.labels for c in soft)
+
+    # per (constraint, topo value) matching-pod counts over matchable nodes
+    by_name = {n.name: n for n in nodes}
+    counts: Dict[Tuple[int, str], int] = {}
+    for ci, c in enumerate(soft):
+        for ex in existing:
+            exn = by_name.get(ex.node_name)
+            if exn is None or not node_matchable(exn):
+                continue
+            if c.topology_key not in exn.labels:
+                continue
+            if ex.namespace != pod.namespace:
+                continue
+            if not selector_matches(c.selector, ex.labels):
+                continue
+            key = (ci, exn.labels[c.topology_key])
+            counts[key] = counts.get(key, 0) + 1
+
+    raw = {}
+    for n in nodes:
+        r = 0
+        for ci, c in enumerate(soft):
+            if c.topology_key in n.labels:
+                r += counts.get((ci, n.labels[c.topology_key]), 0)
+        raw[n.name] = r
+
+    elig_nodes = [n for n in nodes if elig(n)]
+    if not elig_nodes:
+        return out
+    total = sum(raw[n.name] for n in elig_nodes)
+    mn = min(raw[n.name] for n in elig_nodes)
+    denom = total - mn
+    for n in elig_nodes:
+        out[n.name] = (100.0 * (total - raw[n.name]) / denom
+                       if denom > 0 else 100.0)
+    return out
+
+
+def selector_spread_scores(
+    pod: Pod, nodes: Sequence[Node], existing: Sequence[Pod]
+) -> Dict[str, float]:
+    """SelectorSpread (selector_spreading.go:62-165): fewest same-owner pods
+    per node, zone-blended 1/3:2/3 when zone labels exist."""
+    out = {n.name: 0.0 for n in nodes}
+    if not pod.spread_selectors:
+        return out
+
+    def matches(ex: Pod) -> bool:
+        return ex.namespace == pod.namespace and all(
+            selector_matches(s, ex.labels) for s in pod.spread_selectors)
+
+    count = {n.name: 0 for n in nodes}
+    for ex in existing:
+        if ex.node_name in count and matches(ex):
+            count[ex.node_name] += 1
+
+    def zone_of(n: Node):
+        for zl in ZONE_LABELS:
+            if zl in n.labels:
+                return (zl, n.labels[zl])
+        return None
+
+    max_n = max(count.values(), default=0)
+    zcounts: Dict[tuple, int] = {}
+    for n in nodes:
+        z = zone_of(n)
+        if z is not None:
+            zcounts[z] = zcounts.get(z, 0) + count[n.name]
+    max_z = max(zcounts.values(), default=0)
+    have_zones = bool(zcounts)
+
+    for n in nodes:
+        f = 100.0
+        if max_n > 0:
+            f = 100.0 * (max_n - count[n.name]) / max_n
+        z = zone_of(n)
+        if have_zones and z is not None:
+            zs = 100.0
+            if max_z > 0:
+                zs = 100.0 * (max_z - zcounts[z]) / max_z
+            f = f * (1.0 - ZONE_WEIGHTING) + ZONE_WEIGHTING * zs
+        out[n.name] = f
+    return out
+
+
+def image_locality_scores(
+    pod: Pod, nodes: Sequence[Node]
+) -> Dict[str, float]:
+    """ImageLocality (image_locality.go:39-92): sum of spread-scaled sizes of
+    the pod's images already present on the node, clamped and scaled."""
+    total = max(len(nodes), 1)
+    num_nodes = {
+        img: sum(1 for n in nodes if img in n.images_kib)
+        for n_ in nodes for img in n_.images_kib
+    }
+    sizes: Dict[str, int] = {}
+    for n in nodes:
+        for img, s in n.images_kib.items():
+            sizes.setdefault(img, s)
+    out = {}
+    for n in nodes:
+        s = 0.0
+        for img in pod.images:
+            if img in n.images_kib:
+                spread = num_nodes.get(img, 0) / total
+                s += sizes.get(img, 0) * spread
+        s = min(max(s, IMG_MIN_KIB), IMG_MAX_KIB)
+        out[n.name] = 100.0 * (s - IMG_MIN_KIB) / (IMG_MAX_KIB - IMG_MIN_KIB)
+    return out

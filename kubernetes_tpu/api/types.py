@@ -317,11 +317,18 @@ class Pod:
     uid: str = ""
     labels: Dict[str, str] = field(default_factory=dict)
     requests: Resources = field(default_factory=Resources)
+    limits: Resources = field(default_factory=Resources)  # container limits sum
     node_selector: Dict[str, str] = field(default_factory=dict)  # spec.nodeSelector
     affinity: Affinity = field(default_factory=Affinity)
     tolerations: Tuple[Toleration, ...] = ()
     topology_spread: Tuple[TopologySpreadConstraint, ...] = ()
     host_ports: Tuple[HostPort, ...] = ()
+    # container image names (ImageLocality; spec.containers[*].image)
+    images: Tuple[str, ...] = ()
+    # selectors of the Services/RCs/RSs/StatefulSets owning this pod —
+    # the SelectorSpread inputs the reference resolves via listers
+    # (selector_spreading.go getSelectors); resolved by the caller here
+    spread_selectors: Tuple[LabelSelector, ...] = ()
     priority: int = 0
     node_name: str = ""  # spec.nodeName — set once bound
     scheduler_name: str = DEFAULT_SCHEDULER_NAME

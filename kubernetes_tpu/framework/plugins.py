@@ -19,6 +19,11 @@ from ..ops.assign import mask_components
 from ..ops.fit import resource_scores_row
 from ..ops.interpod import soft_affinity_row
 from ..ops.lattice import build_cycle
+from ..ops.scores import (
+    even_spread_soft_row,
+    image_locality_static,
+    selector_spread_row,
+)
 from .interface import (
     CycleState,
     FilterPlugin,
@@ -105,15 +110,150 @@ class InterPodAffinity(FilterPlugin, ScorePlugin):
         D = cyc.ELD.shape[2] - 1
         return jax.vmap(
             lambda c: soft_affinity_row(
-                c, tables.classes, tables.terms, cyc.CNT, tables.nodes, D)
+                c, tables.classes, tables.terms, cyc.CNT, tables.nodes, D,
+                TM=cyc.TM, WSYM=cyc.WSYM)
         )(ctx.pending.cls)
 
 
-class PodTopologySpread(FilterPlugin):
-    """podtopologyspread/ — EvenPodsSpreadPredicate (predicates.go:1643)."""
+class PodTopologySpread(FilterPlugin, ScorePlugin):
+    """podtopologyspread/ — EvenPodsSpreadPredicate (predicates.go:1643)
+    filter + the ScheduleAnyway score (even_pods_spread.go:106-227)."""
 
     def filter_mask(self, state: CycleState, ctx: TensorContext):
         return ctx.components.spread
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        tables, cyc = ctx.tables, ctx.cyc
+        D = cyc.ELD.shape[2] - 1
+        return jax.vmap(
+            lambda c: even_spread_soft_row(
+                c, tables.classes, tables.terms, cyc.CNT, tables.nodes,
+                cyc.static.node_match[c], D)
+        )(ctx.pending.cls)
+
+
+class SelectorSpread(ScorePlugin):
+    """defaultpodtopologyspread/ — SelectorSpread across hosts and zones
+    (priorities/selector_spreading.go:62-165; Pod.spread_selectors carries the
+    Service/RC/RS/StatefulSet owner selectors the reference resolves via
+    listers)."""
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        tables, cyc = ctx.tables, ctx.cyc
+        D = cyc.ELD.shape[2] - 1
+        return jax.vmap(
+            lambda c: selector_spread_row(
+                c, tables.classes, cyc.CNT, tables.nodes, tables.zone_keys, D)
+        )(ctx.pending.cls)
+
+
+class ImageLocality(ScorePlugin):
+    """imagelocality/ — spread-scaled image-size score
+    (priorities/image_locality.go:39-92)."""
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        return ctx.cyc.static.img_score[ctx.pending.cls]
+
+
+class NodeLabel(ScorePlugin):
+    """nodelabel/ — presence/absence label preferences
+    (priorities/node_label.go:46-71). Config: {"present": [...keys],
+    "absent": [...keys]}; score = 100 × hits / #prefs."""
+
+    def __init__(self, present=(), absent=()):
+        self.present = tuple(present)
+        self.absent = tuple(absent)
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        nodes = ctx.tables.nodes
+        P = ctx.pending.valid.shape[0]
+        N = nodes.valid.shape[0]
+        prefs = len(self.present) + len(self.absent)
+        if prefs == 0:
+            return jnp.zeros((P, N), jnp.float32)
+        # label-key ids resolved host-side by the config wiring
+        # (SchedulerServer interns self.present/self.absent into
+        # _present_ids/_absent_ids). A 'present' key missing from the vocab
+        # can match no node; an 'absent' key missing from the vocab is
+        # absent from every node — both handled without touching the -1
+        # padding in label_keys.
+        hits = jnp.zeros((N,), jnp.float32)
+        for kid in getattr(self, "_present_ids", ()):
+            if kid >= 0:
+                hits = hits + (nodes.label_keys == kid).any(-1)
+        for kid in getattr(self, "_absent_ids", ()):
+            if kid >= 0:
+                hits = hits + ~((nodes.label_keys == kid).any(-1))
+            else:
+                hits = hits + 1.0
+        score = 100.0 * hits / prefs
+        return jnp.broadcast_to(score[None, :], (P, N))
+
+
+class RequestedToCapacityRatio(ScorePlugin):
+    """requestedtocapacityratio/ — broken-linear utilization shape
+    (priorities/requested_to_capacity_ratio.go:30-146). Config: shape points
+    [(utilization%, score)], default [(0,100),(100,0)] = least-utilized."""
+
+    def __init__(self, shape=((0, 100), (100, 0))):
+        # accept both the reference arg format [{"utilization": u, "score" : s}]
+        # and plain (u, s) pairs
+        pts = []
+        for p in shape:
+            if isinstance(p, dict):
+                pts.append((float(p["utilization"]), float(p["score"])))
+            else:
+                pts.append((float(p[0]), float(p[1])))
+        self.shape = tuple(pts)
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        tables = ctx.tables
+
+        xs = jnp.array([p[0] for p in self.shape], jnp.float32)
+        ys = jnp.array([p[1] for p in self.shape], jnp.float32)
+
+        def row(c):
+            req_vec = tables.reqs.vec[tables.classes.rid[c]]
+            total = tables.nodes.used + req_vec[None, :]
+            cap = tables.nodes.alloc
+
+            def util(t, cp):
+                return jnp.where(
+                    cp > 0,
+                    100.0 * t.astype(jnp.float32)
+                    / jnp.maximum(cp.astype(jnp.float32), 1.0),
+                    0.0)
+
+            def eval_shape(u):
+                # buildBrokenLinearFunction: clamp below/above, interpolate
+                u = jnp.clip(u, xs[0], xs[-1])
+                return jnp.interp(u, xs, ys)
+
+            s_cpu = eval_shape(util(total[:, 0], cap[:, 0]))
+            s_mem = eval_shape(util(total[:, 1], cap[:, 1]))
+            return (s_cpu + s_mem) / 2.0
+
+        return jax.vmap(row)(ctx.pending.cls)
+
+
+class ResourceLimits(ScorePlugin):
+    """noderesources/resource_limits.go — tie-break score 1 when the node can
+    satisfy the pod's cpu or memory LIMITS, else 0 (feature-gated off by
+    default in the reference, kube_features.go ResourceLimitsPriorityFunction)."""
+
+    def score_matrix(self, state: CycleState, ctx: TensorContext):
+        tables = ctx.tables
+        classes = tables.classes
+
+        def row(c):
+            lim = classes.lim_rid[c]
+            vec = tables.reqs.vec[jnp.maximum(lim, 0)]
+            cap = tables.nodes.alloc
+            cpu_ok = (vec[0] > 0) & (cap[:, 0] > 0) & (vec[0] <= cap[:, 0])
+            mem_ok = (vec[1] > 0) & (cap[:, 1] > 0) & (vec[1] <= cap[:, 1])
+            return jnp.where((lim >= 0) & (cpu_ok | mem_ok), 1.0, 0.0)
+
+        return jax.vmap(row)(ctx.pending.cls)
 
 
 # --------------------------------------------------------------------------- #
@@ -122,7 +262,7 @@ class PodTopologySpread(FilterPlugin):
 
 
 class _ResourceScoreBase(ScorePlugin):
-    _index = 0  # 0 = least, 1 = balanced
+    _index = 0  # 0 = least, 1 = balanced, 2 = most
 
     def score_matrix(self, state: CycleState, ctx: TensorContext):
         tables = ctx.tables
@@ -131,8 +271,8 @@ class _ResourceScoreBase(ScorePlugin):
             req_vec = tables.reqs.vec[tables.classes.rid[c]]
             return resource_scores_row(req_vec, tables.nodes.used, tables.nodes.alloc)
 
-        pair = jax.vmap(row)(ctx.pending.cls)
-        return pair[self._index]
+        triple = jax.vmap(row)(ctx.pending.cls)
+        return triple[self._index]
 
 
 class NodeResourcesLeastAllocated(_ResourceScoreBase):
@@ -192,6 +332,47 @@ class NodeAffinityScore(ScorePlugin):
 # --------------------------------------------------------------------------- #
 
 
+# score plugins whose semantics are compiled INTO the fused engines via
+# EngineConfig weights (ops/lattice.py); anything else configured at the
+# score point reaches the fused path as a per-class bias matrix
+# (extra_score_plugins → sched/cycle.py)
+FUSED_SCORE_PLUGINS = frozenset({
+    "NodeResourcesLeastAllocated", "NodeResourcesBalancedAllocation",
+    "NodeResourcesMostAllocated", "NodeAffinityScore", "TaintToleration",
+    "InterPodAffinity", "PodTopologySpread", "SelectorSpread", "ImageLocality",
+    # registry alias for SelectorSpread (default_registry.go keeps both
+    # names); it must not leak into the class-pure extras path — its score
+    # depends on in-cycle placements
+    "DefaultPodTopologySpread",
+})
+
+
+def extra_score_plugins(framework) -> tuple:
+    """(plugin, weight) pairs for configured score plugins OUTSIDE the fused
+    set — NodeLabel, RequestedToCapacityRatio, ResourceLimits,
+    NodePreferAvoidPods, or any custom registration. These are class-pure
+    (their scores depend only on (class, node), not on in-cycle placement),
+    so the fused dispatch evaluates them once per cycle as a [SC, N] bias
+    added to the static score lattice."""
+    if framework is None:
+        return ()
+    return tuple(
+        (pl, float(getattr(pl, "weight", 1)))
+        for pl in framework.score_plugins
+        if getattr(pl, "name", type(pl).__name__) not in FUSED_SCORE_PLUGINS
+    )
+
+
+def _make_node_label(cfg: dict) -> "NodeLabel":
+    """NodeLabel needs vocab ids for its configured label keys; the config
+    loader resolves them (present_ids/absent_ids). String keys are kept for
+    introspection."""
+    p = NodeLabel(present=cfg.get("present", ()), absent=cfg.get("absent", ()))
+    p._present_ids = tuple(cfg.get("present_ids", ()))
+    p._absent_ids = tuple(cfg.get("absent_ids", ()))
+    return p
+
+
 def default_registry() -> Registry:
     return {
         "NodeResourcesFit": lambda cfg: NodeResourcesFit(),
@@ -207,6 +388,13 @@ def default_registry() -> Registry:
         "NodeResourcesMostAllocated": lambda cfg: NodeResourcesMostAllocated(),
         "NodePreferAvoidPods": lambda cfg: NodePreferAvoidPods(),
         "NodeAffinityScore": lambda cfg: NodeAffinityScore(),
+        "SelectorSpread": lambda cfg: SelectorSpread(),
+        "DefaultPodTopologySpread": lambda cfg: SelectorSpread(),
+        "ImageLocality": lambda cfg: ImageLocality(),
+        "NodeLabel": lambda cfg: _make_node_label(cfg or {}),
+        "RequestedToCapacityRatio": lambda cfg: RequestedToCapacityRatio(
+            shape=(cfg or {}).get("shape", ((0, 100), (100, 0)))),
+        "NodeResourcesResourceLimits": lambda cfg: ResourceLimits(),
     }
 
 
@@ -222,6 +410,7 @@ def default_plugins() -> Plugins:
         score=PluginSet(enabled=[
             "NodeResourcesLeastAllocated", "NodeResourcesBalancedAllocation",
             "NodeAffinityScore", "TaintToleration", "InterPodAffinity",
+            "PodTopologySpread", "SelectorSpread", "ImageLocality",
         ]),
     )
 

@@ -32,6 +32,7 @@ from .fit import fit_row, resource_scores_row
 from .interpod import affinity_rows, domain_of_term, soft_affinity_row
 from .lattice import CycleArrays
 from .ports import port_conflict_row
+from .scores import even_spread_soft_row, selector_spread_row
 from .topospread import spread_row
 
 
@@ -42,6 +43,7 @@ class AssignState(NamedTuple):
     ppt: Array   # [N, PWt] u32 — exact triples in use
     CNT: Array   # [S, N] i32 — per-node term match counts
     HOLD: Array  # [S, N] i32 — per-node anti-term holders
+    WSYM: Array  # [S, N] f32 — signed symmetric soft-affinity weights
 
 
 class AssignResult(NamedTuple):
@@ -78,11 +80,9 @@ def assign_batch(
 
         mask = pod_mask_row(tables, cyc, state, c, pods.node_name_req[idx], p_valid)
 
-        # ---- Score row (weighted sum, all default weights 1;
-        #      generic_scheduler.go:823-832) ----
-        least, balanced = resource_scores_row(req_vec, state.used, nodes.alloc)
-        soft_ip = soft_affinity_row(c, classes, terms, state.CNT, nodes, D)
-        score = cyc.static.score[c] + least + balanced + soft_ip
+        # ---- Score row (weighted sum; component weights/enables come from
+        #      the traced EngineConfig — generic_scheduler.go:823-832) ----
+        score = score_row(tables, cyc, state, c)
         score = jnp.where(mask, score, -jnp.inf)
 
         choice = jnp.argmax(score)
@@ -106,8 +106,10 @@ def assign_batch(
         CNT = state.CNT.at[:, choice].add(inc)
         inc_h = (cyc.has_anti[c] & feasible).astype(jnp.int32)
         HOLD = state.HOLD.at[:, choice].add(inc_h)
+        WSYM = state.WSYM.at[:, choice].add(
+            jnp.where(feasible, cyc.WCOLS[:, c], 0.0))
 
-        return AssignState(used, ppa, ppw, ppt, CNT, HOLD), (node, feasible)
+        return AssignState(used, ppa, ppw, ppt, CNT, HOLD, WSYM), (node, feasible)
 
     final, (nodes_sorted, feas_sorted) = jax.lax.scan(step, init, order)
 
@@ -127,12 +129,18 @@ def pod_mask_row(
 ) -> Array:
     """Full Filter mask [N] for one pod against a given assume-state — the
     tensor analog of podFitsOnNode (generic_scheduler.go:628-706). Shared by
-    the assignment scan and the golden-test / extender surfaces."""
+    the assignment scan and the golden-test / extender surfaces. Each
+    component honors its EngineConfig plugin flag (a disabled filter plugin
+    never blocks, matching CreateFromKeys composition)."""
+    from .lattice import _on
+
     nodes, classes, terms = tables.nodes, tables.classes, tables.terms
+    ecfg = cyc.ecfg
     D = cyc.ELD.shape[2] - 1
     rid = classes.rid[cls]
     req_vec = tables.reqs.vec[rid]
-    fit = fit_row(req_vec, state.used, nodes.alloc, nodes.valid)
+    fit = fit_row(req_vec, state.used, nodes.alloc, nodes.valid) \
+        | ~_on(ecfg.f_fit)
     ps = classes.portset[cls]
     psafe = jnp.maximum(ps, 0)
     conflict = port_conflict_row(
@@ -141,19 +149,49 @@ def pod_mask_row(
         tables.portsets.trip_words[psafe],
         state.ppa, state.ppw, state.ppt,
     )
-    port_ok = (ps < 0) | ~conflict
+    port_ok = (ps < 0) | ~conflict | ~_on(ecfg.f_ports)
     aff_ok, anti_ok = affinity_rows(
         cls, classes, terms, cyc.TM, state.CNT, state.HOLD, nodes, D
     )
+    interpod_ok = (aff_ok & anti_ok) | ~_on(ecfg.f_interpod)
     spread_ok = spread_row(
         cls, classes, terms, cyc.TM, state.CNT, cyc.ELD,
         cyc.static.node_match[cls], nodes, D,
-    )
-    host_ok = (node_name_req < 0) | (nodes.name_id == node_name_req)
+    ) | ~_on(ecfg.f_spread)
+    host_ok = (node_name_req < 0) | (nodes.name_id == node_name_req) \
+        | ~_on(ecfg.f_name)
     return (
         cyc.static.mask[cls]
-        & fit & port_ok & aff_ok & anti_ok & spread_ok & host_ok & valid
+        & fit & port_ok & interpod_ok & spread_ok & host_ok & valid
     )
+
+
+def score_row(
+    tables: ClusterTables,
+    cyc: CycleArrays,
+    state: AssignState,
+    cls: Array,
+) -> Array:
+    """Full Score row [N] for one pod class against a live assume-state —
+    prioritizeNodes' weighted sum (generic_scheduler.go:714-869) with the
+    EngineConfig carrying per-plugin weights. Shared by both engines and the
+    score-matrix surface."""
+    nodes, classes, terms = tables.nodes, tables.classes, tables.terms
+    w = cyc.ecfg
+    D = cyc.ELD.shape[2] - 1
+    req_vec = tables.reqs.vec[classes.rid[cls]]
+    least, balanced, most = resource_scores_row(req_vec, state.used,
+                                                nodes.alloc)
+    soft_ip = soft_affinity_row(cls, classes, terms, state.CNT, nodes, D,
+                                TM=cyc.TM, WSYM=state.WSYM)
+    even_soft = even_spread_soft_row(
+        cls, classes, terms, state.CNT, nodes, cyc.static.node_match[cls], D)
+    ssel = selector_spread_row(
+        cls, classes, state.CNT, nodes, tables.zone_keys, D)
+    return (cyc.static.score[cls] + least * w.w_least
+            + balanced * w.w_balanced + most * w.w_most
+            + soft_ip * w.w_interpod + even_soft * w.w_even
+            + ssel * w.w_ssel)
 
 
 def feasible_matrix(
@@ -234,12 +272,8 @@ def score_matrix(
     D = cyc.ELD.shape[2] - 1
 
     def row(c, nnr, v):
-        req_vec = tables.reqs.vec[classes.rid[c]]
         mask = pod_mask_row(tables, cyc, state, c, nnr, v)
-        least, balanced = resource_scores_row(req_vec, state.used, nodes.alloc)
-        soft_ip = soft_affinity_row(c, classes, terms, state.CNT, nodes, D)
-        score = cyc.static.score[c] + least + balanced + soft_ip
-        return jnp.where(mask, score, -jnp.inf)
+        return jnp.where(mask, score_row(tables, cyc, state, c), -jnp.inf)
 
     return jax.vmap(row)(pods.cls, pods.node_name_req, pods.valid)
 
@@ -248,5 +282,5 @@ def initial_state(tables: ClusterTables, cyc: CycleArrays) -> AssignState:
     n = tables.nodes
     return AssignState(
         used=n.used, ppa=n.port_pair_any, ppw=n.port_pair_wild, ppt=n.port_triple,
-        CNT=cyc.CNT, HOLD=cyc.HOLD,
+        CNT=cyc.CNT, HOLD=cyc.HOLD, WSYM=cyc.WSYM,
     )

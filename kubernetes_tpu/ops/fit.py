@@ -58,12 +58,17 @@ def _frac(total: Array, cap: Array) -> Array:
     return jnp.where(cap > 0, total.astype(jnp.float32) / jnp.maximum(cap_f, 1.0), 0.0)
 
 
-def resource_scores_row(req_vec: Array, used: Array, alloc: Array) -> tuple[Array, Array]:
-    """(least_requested [N], balanced_allocation [N]) in 0..100 float32.
+def resource_scores_row(
+    req_vec: Array, used: Array, alloc: Array
+) -> tuple[Array, Array, Array]:
+    """(least_requested [N], balanced_allocation [N], most_requested [N]) in
+    0..100 float32.
 
     least_requested.go:60-77: per-resource (cap−total)*100/cap clamped at 0,
     averaged over cpu+memory. balanced_resource_allocation.go:68-102:
-    100 − |cpuFraction−memFraction|*100, 0 if either fraction ≥ 1."""
+    100 − |cpuFraction−memFraction|*100, 0 if either fraction ≥ 1.
+    most_requested.go:52-70: total*100/cap averaged (bin packing; weight 0 in
+    the default provider, enabled via config EngineConfig.w_most)."""
     total = used + req_vec[None, :]  # [N, R]
     cpu_cap, mem_cap = alloc[:, 0], alloc[:, 1]
     cpu_t, mem_t = total[:, 0], total[:, 1]
@@ -73,10 +78,16 @@ def resource_scores_row(req_vec: Array, used: Array, alloc: Array) -> tuple[Arra
         s = s / jnp.maximum(cap.astype(jnp.float32), 1.0)
         return jnp.where((cap > 0) & (t <= cap), s, 0.0)
 
+    def most(t, cap):
+        s = t.astype(jnp.float32) * MAX_NODE_SCORE \
+            / jnp.maximum(cap.astype(jnp.float32), 1.0)
+        return jnp.where((cap > 0) & (t <= cap), s, 0.0)
+
     least_score = (least(cpu_t, cpu_cap) + least(mem_t, mem_cap)) / 2.0
+    most_score = (most(cpu_t, cpu_cap) + most(mem_t, mem_cap)) / 2.0
 
     cf, mf = _frac(cpu_t, cpu_cap), _frac(mem_t, mem_cap)
     balanced = jnp.where(
         (cf >= 1.0) | (mf >= 1.0), 0.0, MAX_NODE_SCORE - jnp.abs(cf - mf) * MAX_NODE_SCORE
     )
-    return least_score, balanced
+    return least_score, balanced, most_score

@@ -103,7 +103,7 @@ def domain_agg(
         vals = jnp.where(eligible, vals, 0)
     idx = jnp.where(dom >= 0, dom, D)
     A = vals.shape[0]
-    seg = jnp.zeros((A, D + 1), jnp.int32)
+    seg = jnp.zeros((A, D + 1), vals.dtype)
     return seg.at[jnp.arange(A)[:, None], idx].add(vals)
 
 
@@ -159,10 +159,16 @@ def soft_affinity_row(
     CNT_node: Array,
     nodes: NodeArrays,
     D: int,
+    TM: Array | None = None,
+    WSYM: Array | None = None,
 ) -> Array:
     """Preferred inter-pod (anti)affinity score [N] f32, 0..100 after min/max
-    normalization (interpod_affinity.go:119-215; symmetric weighting of existing
-    pods' preferred terms is a TODO — see docs/PARITY.md)."""
+    normalization (interpod_affinity.go:119-215). Both directions: the incoming
+    pod's preferred terms against existing pods, AND — when TM/WSYM are given —
+    the symmetric pass (existing pods' preferred terms and hard-affinity
+    symmetric weight matching the incoming pod, :156-185), summed into the raw
+    counts before normalization exactly as the reference's single `counts`
+    array is."""
 
     def contrib(term_slots: Array, weights: Array, sign: float) -> Array:
         s = jnp.maximum(term_slots, 0)
@@ -175,6 +181,10 @@ def soft_affinity_row(
     raw = contrib(classes.paff_terms[cls], classes.paff_w[cls], 1.0) + contrib(
         classes.panti_terms[cls], classes.panti_w[cls], -1.0
     )
+    if TM is not None and WSYM is not None:
+        from .scores import sym_affinity_contrib
+
+        raw = raw + sym_affinity_contrib(cls, TM, WSYM, terms, nodes, D)
     lo = jnp.min(jnp.where(nodes.valid, raw, jnp.inf))
     hi = jnp.max(jnp.where(nodes.valid, raw, -jnp.inf))
     return jnp.where(hi > lo, 100.0 * (raw - lo) / jnp.maximum(hi - lo, 1e-9), 0.0)

@@ -22,16 +22,66 @@ import jax.numpy as jnp
 from ..state.arrays import Array, ClusterTables, PodArrays
 from .interpod import class_term_membership, per_node_counts, term_class_matrix
 from .labels import node_term_matrix
+from .scores import image_locality_static, symmetric_weight_cols, weighted_per_node
 from .taints import taint_matrices, taint_toleration_score
 from .topospread import eligible_domains
+
+
+class EngineConfig(NamedTuple):
+    """How KubeSchedulerConfiguration's plugin composition reaches the fused
+    one-dispatch engines: per-component filter enables and score weights as
+    TRACED f32 scalars — config changes never recompile, a disabled plugin is
+    flag/weight 0. Components correspond 1:1 to the in-tree plugin names
+    (framework/plugins.py); plugins outside this fixed set (NodeLabel,
+    RequestedToCapacityRatio, …) run through the Framework plugin path.
+
+    The reference analog is the plugin set built by CreateFromConfig/
+    CreateFromKeys (factory.go:309,387) driving which predicates/priorities
+    run inside the scheduling loop."""
+
+    f_unsched: Array        # NodeUnschedulable
+    f_name: Array           # NodeName (spec.nodeName)
+    f_ports: Array          # NodePorts
+    f_node_affinity: Array  # NodeAffinity (nodeSelector + required affinity)
+    f_fit: Array            # NodeResourcesFit
+    f_taints: Array         # TaintToleration
+    f_interpod: Array       # InterPodAffinity (required + symmetry)
+    f_spread: Array         # PodTopologySpread (DoNotSchedule)
+    w_node_affinity: Array  # NodeAffinityScore (preferred terms)
+    w_taint: Array          # TaintToleration score
+    w_img: Array            # ImageLocality
+    w_least: Array          # NodeResourcesLeastAllocated
+    w_balanced: Array       # NodeResourcesBalancedAllocation
+    w_most: Array           # NodeResourcesMostAllocated (0 in defaults)
+    w_interpod: Array       # InterPodAffinity soft score (both directions)
+    w_even: Array           # PodTopologySpread ScheduleAnyway score
+    w_ssel: Array           # SelectorSpread
+
+
+def default_engine_config() -> EngineConfig:
+    """The default provider's composition: every filter on, the default score
+    set at weight 1, MostAllocated off (algorithmprovider/defaults)."""
+    one, zero = 1.0, 0.0
+    return EngineConfig(
+        f_unsched=one, f_name=one, f_ports=one, f_node_affinity=one,
+        f_fit=one, f_taints=one, f_interpod=one, f_spread=one,
+        w_node_affinity=one, w_taint=one, w_img=one, w_least=one,
+        w_balanced=one, w_most=zero, w_interpod=one, w_even=one, w_ssel=one,
+    )
+
+
+def _on(flag: Array) -> Array:
+    """A filter component is enforced when its flag ≥ 0.5 (f32 scalar)."""
+    return jnp.asarray(flag, jnp.float32) >= 0.5
 
 
 class StaticLattice(NamedTuple):
     mask: Array        # [SC, N] — static Filter conjunction
     node_match: Array  # [SC, N] — nodeSelector ∧ node-affinity only (spread eligibility)
-    score: Array       # [SC, N] f32 — static Score sum (pref_score + taint_score)
+    score: Array       # [SC, N] f32 — static Score sum (pref + taint + image)
     pref_score: Array  # [SC, N] f32 — preferred node affinity, 0..100-normalized
     taint_score: Array # [SC, N] f32 — taint PreferNoSchedule score, 0..100
+    img_score: Array   # [SC, N] f32 — ImageLocality, 0..100
 
 
 class CycleArrays(NamedTuple):
@@ -43,6 +93,9 @@ class CycleArrays(NamedTuple):
     CNT: Array       # [S, N] per-node term match counts (live carry seed)
     HOLD: Array      # [S, N] per-node anti-term holder counts (live carry seed)
     ELD: Array       # [SC, TS, D+1] eligible domains per class × constraint
+    WCOLS: Array     # [S, SC] f32 signed symmetric-preference weights per class
+    WSYM: Array      # [S, N] f32 symmetric weight seed from existing pods
+    ecfg: EngineConfig  # traced plugin composition (filters + score weights)
 
 
 def _safe_row_gather(M: Array, ids: Array, default: bool) -> Array:
@@ -52,8 +105,11 @@ def _safe_row_gather(M: Array, ids: Array, default: bool) -> Array:
 
 
 def build_static(
-    tables: ClusterTables, unschedulable_key: int, empty_val: int
+    tables: ClusterTables, unschedulable_key: int, empty_val: int,
+    ecfg: EngineConfig | None = None,
 ) -> StaticLattice:
+    if ecfg is None:
+        ecfg = default_engine_config()
     nodes, classes = tables.nodes, tables.classes
 
     MT = node_term_matrix(tables.nterms, nodes)  # [SN, N]
@@ -68,6 +124,9 @@ def build_static(
     aff_ok = (~classes.aff_active)[:, None] | aff_any
 
     node_match = nsel_ok & aff_ok & nodes.valid[None, :]
+    # spread eligibility always uses the raw node_match; the FILTER honors
+    # the NodeAffinity plugin flag
+    node_match_f = (node_match | ~_on(ecfg.f_node_affinity)) & nodes.valid[None, :]
 
     # taints (PodToleratesNodeTaints) + spec.unschedulable (CheckNodeUnschedulable)
     tol_ok, prefer_cnt, unsched_ok = taint_matrices(
@@ -77,7 +136,9 @@ def build_static(
     taint_ok = tol_ok[ts]  # [SC, N]
     unsched_pass = (~nodes.unschedulable)[None, :] | unsched_ok[ts][:, None]
 
-    mask = node_match & taint_ok & unsched_pass & classes.valid[:, None]
+    taint_ok_f = taint_ok | ~_on(ecfg.f_taints)
+    unsched_f = unsched_pass | ~_on(ecfg.f_unsched)
+    mask = node_match_f & taint_ok_f & unsched_f & classes.valid[:, None]
 
     # --- static scores ---
     # preferred node affinity (node_affinity.go:34-80): Σ weight·match, then
@@ -89,10 +150,15 @@ def build_static(
     pref_score = jnp.where(mx > 0, pref_raw * 100.0 / jnp.maximum(mx, 1e-9), 0.0)
 
     taint_score = taint_toleration_score(prefer_cnt[ts])  # [SC, N]
+    img_score = image_locality_static(tables)              # [SC, N]
 
+    w = ecfg
+    score = (pref_score * w.w_node_affinity + taint_score * w.w_taint
+             + img_score * w.w_img)
     return StaticLattice(mask=mask, node_match=node_match,
-                         score=pref_score + taint_score,
-                         pref_score=pref_score, taint_score=taint_score)
+                         score=score,
+                         pref_score=pref_score, taint_score=taint_score,
+                         img_score=img_score)
 
 
 def build_cycle(
@@ -101,13 +167,18 @@ def build_cycle(
     unschedulable_key: int,
     empty_val: int,
     D: int,
+    hard_weight=1,
+    ecfg: EngineConfig | None = None,
 ) -> CycleArrays:
     """Everything the scan needs, computed in one fused pass on device.
     The analog of RunPreFilterPlugins + GetPredicateMetadata
     (generic_scheduler.go:206, metadata.go:334) — but once per *cycle*, shared
     by every pod, instead of once per pod. `D` (domain-axis capacity) must be
     static under jit — pass via static_argnums/partial."""
-    static = build_static(tables, unschedulable_key, empty_val)
+    if ecfg is None:
+        ecfg = default_engine_config()
+    ecfg = EngineConfig(*[jnp.asarray(x, jnp.float32) for x in ecfg])
+    static = build_static(tables, unschedulable_key, empty_val, ecfg)
     TM = term_class_matrix(tables.terms, tables.labelsets, tables.classes)
     S = TM.shape[0]
     N = tables.nodes.valid.shape[0]
@@ -115,4 +186,7 @@ def build_cycle(
     CNT = per_node_counts(TM, existing, N)
     HOLD = per_node_counts(has_anti.T, existing, N)
     ELD = eligible_domains(static.node_match, tables.classes, tables.nodes, D)
-    return CycleArrays(static=static, TM=TM, has_anti=has_anti, CNT=CNT, HOLD=HOLD, ELD=ELD)
+    WCOLS = symmetric_weight_cols(tables.classes, S, hard_weight)
+    WSYM = weighted_per_node(WCOLS, existing, N)
+    return CycleArrays(static=static, TM=TM, has_anti=has_anti, CNT=CNT,
+                       HOLD=HOLD, ELD=ELD, WCOLS=WCOLS, WSYM=WSYM, ecfg=ecfg)

@@ -20,13 +20,19 @@ TPU re-design — everything is one jitted dispatch:
     row, so per-node sequential semantics are preserved exactly;
   * node choice is a masked lexicographic argmin on device.
 
-Documented deviations (docs/PARITY.md): no PDB accounting (criterion 1) and no
-start-time tiebreak (criterion 5) — the API surface has neither PDBs nor start
-times yet; reprieve re-checks resources/ports exactly, and handles affinity/
-spread via a conservative precomputed "restoration would re-block" bit instead
-of a full predicate re-run (a victim that *might* re-block is simply not
-reprieved — strictly more victims than the reference in rare affinity cases,
-never a false 'schedulable')."""
+PDB awareness (criterion 1): `pdb_blocked[e]` — computed host-side from the
+PodDisruptionBudget state (filterPodsWithPDBViolation, :1071-1100: pod matches
+a PDB in its namespace with PodDisruptionsAllowed ≤ 0) — orders the reprieve
+pass so PDB-violating victims are restored FIRST (:1149-1156), counts the
+surviving violations per node, and makes that count the PRIMARY node-choice
+key. Criterion 5 (latest earliest start among highest-priority victims,
+:1000-1028) uses creation_index as the start-time proxy.
+
+Documented deviation (docs/PARITY.md): reprieve re-checks resources/ports
+exactly, and handles affinity/spread via a conservative precomputed
+"restoration would re-block" bit instead of a full predicate re-run (a victim
+that *might* re-block is simply not reprieved — strictly more victims than the
+reference in rare affinity cases, never a false 'schedulable')."""
 
 from __future__ import annotations
 
@@ -47,6 +53,7 @@ class PreemptResult(NamedTuple):
     node: Array      # scalar i32 — chosen node index, -1 if preemption can't help
     victims: Array   # [E] bool — victims on the chosen node
     n_candidates: Array  # scalar i32 — nodes where preemption would work
+    n_pdb_violations: Array  # scalar i32 — PDB-violating victims on the node
 
 
 def _pairwise_port_conflict(
@@ -76,12 +83,15 @@ def preempt_for_pod(
     node_name_req: Array,  # scalar: spec.nodeName id or -1
     priority: Array,       # scalar: preemptor's priority
     D: int,
+    pdb_blocked: Array | None = None,   # [E] bool — eviction violates a PDB
 ) -> PreemptResult:
     nodes, classes, terms = tables.nodes, tables.classes, tables.terms
     N = nodes.valid.shape[0]
     E = existing.valid.shape[0]
     I32MAX = jnp.iinfo(jnp.int32).max
 
+    if pdb_blocked is None:
+        pdb_blocked = jnp.zeros((existing.valid.shape[0],), bool)
     cls_e = jnp.maximum(existing.cls, 0)
     node_e = existing.node_id
     on_node = existing.valid & (node_e >= 0)
@@ -140,8 +150,11 @@ def preempt_for_pod(
     spread_block = (hard_ts[:, None] & cyc.TM[ts][:, cls_e]).any(0)     # [E]
     reblock = own_block | sym_block | spread_block
 
-    # ---- reprieve scan (selectVictimsOnNode pass 2), priority-desc order ----
-    order = jnp.lexsort((jnp.arange(E), -existing.priority, ~vict_pot))
+    # ---- reprieve scan (selectVictimsOnNode pass 2): PDB-violating victims
+    # are reprieved FIRST (generic_scheduler.go:1149-1156), each group in
+    # priority-descending order ----
+    order = jnp.lexsort((jnp.arange(E), -existing.priority,
+                         (~pdb_blocked).astype(jnp.int32), ~vict_pot))
 
     def step(carry, e):
         used, conflict, victim = carry
@@ -159,23 +172,35 @@ def preempt_for_pod(
     init = (used_wo, conflict_wo, jnp.zeros((E,), bool))
     (used_f, conf_f, victim), _ = jax.lax.scan(step, init, order)
 
-    # ---- pickOneNodeForPreemption (:903) ----
-    vprio = jnp.where(victim, existing.priority, 0)
+    # ---- pickOneNodeForPreemption (:903): lexicographic over
+    # (1) PDB violations, (2) highest victim priority, (3) priority sum,
+    # (4) victim count, (5) latest earliest start of highest-prio victims ----
     vmask = victim & (node_e_safe < N)
     idx = jnp.where(vmask, node_e_safe, N)
     num_v = jnp.zeros((N + 1,), jnp.int32).at[idx].add(vmask.astype(jnp.int32))[:N]
     sum_p = jnp.zeros((N + 1,), jnp.int32).at[idx].add(jnp.where(vmask, existing.priority, 0))[:N]
     max_p = jnp.full((N + 1,), -I32MAX, jnp.int32).at[idx].max(
         jnp.where(vmask, existing.priority, -I32MAX))[:N]
+    num_pdb = jnp.zeros((N + 1,), jnp.int32).at[idx].add(
+        (vmask & pdb_blocked).astype(jnp.int32))[:N]
+    # earliest (min) creation among each node's highest-priority victims;
+    # pick the node where it is LATEST (GetEarliestPodStartTime, :1000-1028)
+    is_top = vmask & (existing.priority == max_p[jnp.minimum(node_e_safe, N - 1)])
+    est = jnp.full((N + 1,), I32MAX, jnp.int32).at[idx].min(
+        jnp.where(is_top, existing.creation, I32MAX))[:N]
 
     big = I32MAX
+    key0 = jnp.where(cand, num_pdb, big)
     key1 = jnp.where(cand, jnp.where(num_v > 0, max_p, -I32MAX), big)
     key2 = jnp.where(cand, sum_p, big)
     key3 = jnp.where(cand, num_v, big)
-    choice_order = jnp.lexsort((jnp.arange(N), key3, key2, key1))
+    key4 = jnp.where(cand, -est, big)       # latest earliest-start wins
+    choice_order = jnp.lexsort((jnp.arange(N), key4, key3, key2, key1, key0))
     best = choice_order[0]
     any_cand = cand.any()
     node = jnp.where(any_cand, best, -1)
     victims = victim & (node_e == node) & any_cand
+    nv = (victims & pdb_blocked).sum().astype(jnp.int32)
     return PreemptResult(node=node.astype(jnp.int32), victims=victims,
-                         n_candidates=cand.sum().astype(jnp.int32))
+                         n_candidates=cand.sum().astype(jnp.int32),
+                         n_pdb_violations=nv)

@@ -50,9 +50,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..state.arrays import Array, ClusterTables, PodArrays
-from .assign import AssignResult, AssignState, pod_mask_row
-from .fit import _fit, resource_scores_row
-from .interpod import class_term_membership, domain_agg, soft_affinity_row
+from .assign import AssignResult, AssignState, pod_mask_row, score_row
+from .fit import _fit
+from .interpod import class_term_membership, domain_agg
 from .lattice import CycleArrays
 
 # plain Python ints only: a module-level jnp scalar would be captured as a
@@ -105,10 +105,7 @@ def _class_mask_score(tables, cyc, state):
     def row(c):
         mask = pod_mask_row(tables, cyc, state, c, jnp.int32(-1),
                             classes.valid[c])
-        req_vec = tables.reqs.vec[classes.rid[c]]
-        least, balanced = resource_scores_row(req_vec, state.used, nodes.alloc)
-        soft = soft_affinity_row(c, classes, terms, state.CNT, nodes, D)
-        score = cyc.static.score[c] + least + balanced + soft
+        score = score_row(tables, cyc, state, c)
         return mask, jnp.where(mask, score, -jnp.inf)
 
     return jax.vmap(row)(jnp.arange(SC))
@@ -353,10 +350,11 @@ def assign_waves(
         used2 = state.used + jnp.einsum("cn,cr->nr", Ai, req_by_class)
         CNT2 = state.CNT + cyc.TM.astype(jnp.int32) @ Ai
         HOLD2 = state.HOLD + cyc.has_anti.T.astype(jnp.int32) @ Ai
+        WSYM2 = state.WSYM + cyc.WCOLS @ Ai.astype(jnp.float32)
         state2 = AssignState(
             used=used2,
             ppa=state.ppa | orp, ppw=state.ppw | orw, ppt=state.ppt | ort,
-            CNT=CNT2, HOLD=HOLD2,
+            CNT=CNT2, HOLD=HOLD2, WSYM=WSYM2,
         )
 
         # ---- map admissions back to pods (rank among kept, score order) ----

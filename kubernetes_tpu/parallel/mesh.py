@@ -62,6 +62,8 @@ def _node_sharded_tables_spec(tables: ClusterTables) -> ClusterTables:
         portsets=rep(tables.portsets),
         terms=rep(tables.terms),
         classes=rep(tables.classes),
+        images=rep(tables.images),
+        zone_keys=P(),
     )
 
 

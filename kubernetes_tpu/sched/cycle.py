@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from ..api.types import Node, Pod
 from ..ops.assign import AssignResult, assign_batch, initial_state
-from ..ops.lattice import build_cycle
+from ..ops.lattice import build_cycle, default_engine_config
 from ..state.arrays import ClusterTables, PodArrays
 from ..state.dims import Dims
 from ..state.encode import Encoder
@@ -50,7 +50,38 @@ def _engine() -> str:
     return os.environ.get("KTPU_ASSIGN", "waves")
 
 
-@functools.partial(jax.jit, static_argnums=(3, 5))
+def _apply_extra_plugins(tables, cyc, extra_plugins, extra_weights):
+    """Fold configured out-of-set score plugins (NodeLabel, RTCR, …) into the
+    static score lattice as a per-class bias — the fused-path analog of
+    RunScorePlugins for plugins EngineConfig has no fixed slot for. They are
+    evaluated against a per-CLASS identity pending view (their scores are
+    class-pure)."""
+    if not extra_plugins:
+        return cyc
+    from ..framework.interface import CycleState, TensorContext
+
+    classes = tables.classes
+    SC = classes.valid.shape[0]
+    ident = PodArrays(
+        valid=classes.valid,
+        name_id=jnp.full((SC,), -1, jnp.int32),
+        ns=classes.ns,
+        cls=jnp.arange(SC, dtype=jnp.int32),
+        priority=jnp.zeros((SC,), jnp.int32),
+        creation=jnp.zeros((SC,), jnp.int32),
+        node_id=jnp.full((SC,), -1, jnp.int32),
+        node_name_req=jnp.full((SC,), -1, jnp.int32),
+    )
+    ctx = TensorContext(tables=tables, cyc=cyc, pending=ident)
+    bias = jnp.zeros_like(cyc.static.score)
+    for pl, w in zip(extra_plugins, extra_weights):
+        bias = bias + jnp.asarray(w, jnp.float32) * pl.score_matrix(
+            CycleState(), ctx).astype(jnp.float32)
+    return cyc._replace(static=cyc.static._replace(
+        score=cyc.static.score + bias))
+
+
+@functools.partial(jax.jit, static_argnums=(3, 5, 8))
 def _schedule_batch_impl(
     tables: ClusterTables,
     pending: PodArrays,
@@ -58,11 +89,16 @@ def _schedule_batch_impl(
     D: int,
     existing: PodArrays,
     engine: str,
+    hard_weight=1.0,
+    ecfg=None,
+    extra_plugins: tuple = (),
+    extra_weights: tuple = (),
 ) -> AssignResult:
     from ..ops.waves import assign_waves
 
     uk, ev = keys
-    cyc = build_cycle(tables, existing, uk, ev, D)
+    cyc = build_cycle(tables, existing, uk, ev, D, hard_weight, ecfg)
+    cyc = _apply_extra_plugins(tables, cyc, extra_plugins, extra_weights)
     init = initial_state(tables, cyc)
     if engine == "scan":
         return assign_batch(tables, cyc, pending, init)
@@ -70,7 +106,11 @@ def _schedule_batch_impl(
 
 
 def _schedule_batch(tables, pending, keys, D, existing,
-                    has_node_name: bool = False) -> AssignResult:
+                    has_node_name: bool = False,
+                    hard_weight: float = 1.0,
+                    ecfg=None,
+                    extra_plugins: tuple = (),
+                    extra_weights: tuple = ()) -> AssignResult:
     engine = _engine()
     if engine != "scan" and has_node_name:
         # spec.nodeName pods carry a per-POD (not per-class) host constraint
@@ -80,7 +120,13 @@ def _schedule_batch(tables, pending, keys, D, existing,
         # The flag comes from Dims (computed host-side at encode time) so the
         # hot path never blocks on a device readback before dispatch.
         engine = "scan"
-    return _schedule_batch_impl(tables, pending, keys, D, existing, engine)
+    # hardPodAffinitySymmetricWeight (apis/config/types.go:70) and the
+    # EngineConfig plugin composition ride as traced f32 scalars so config
+    # changes never recompile
+    return _schedule_batch_impl(tables, pending, keys, D, existing, engine,
+                                jnp.float32(hard_weight),
+                                ecfg or default_engine_config(),
+                                extra_plugins, extra_weights)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -90,30 +136,40 @@ def _feasible(
     keys: Tuple[jnp.ndarray, jnp.ndarray],
     D: int,
     existing: PodArrays,
+    hard_weight=1.0,
+    ecfg=None,
 ) -> jnp.ndarray:
     """[P, N] Filter mask — findNodesThatFit as one dispatch (golden tests,
     extender Filter verb)."""
     from ..ops.assign import feasible_matrix
 
     uk, ev = keys
-    cyc = build_cycle(tables, existing, uk, ev, D)
+    cyc = build_cycle(tables, existing, uk, ev, D, hard_weight,
+                      ecfg or default_engine_config())
     return feasible_matrix(tables, cyc, pending)
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
+@functools.partial(jax.jit, static_argnums=(3, 7))
 def _scores(
     tables: ClusterTables,
     pending: PodArrays,
     keys: Tuple[jnp.ndarray, jnp.ndarray],
     D: int,
     existing: PodArrays,
+    hard_weight=1.0,
+    ecfg=None,
+    extra_plugins: tuple = (),
+    extra_weights: tuple = (),
 ) -> jnp.ndarray:
     """[P, N] Score matrix — prioritizeNodes as one dispatch (extender
-    Prioritize verb, golden tests)."""
+    Prioritize verb, golden tests). Same composition as the batch path,
+    including configured out-of-set plugins."""
     from ..ops.assign import score_matrix
 
     uk, ev = keys
-    cyc = build_cycle(tables, existing, uk, ev, D)
+    cyc = build_cycle(tables, existing, uk, ev, D, hard_weight,
+                      ecfg or default_engine_config())
+    cyc = _apply_extra_plugins(tables, cyc, extra_plugins, extra_weights)
     return score_matrix(tables, cyc, pending)
 
 
@@ -124,13 +180,16 @@ def _diagnose(
     keys: Tuple[jnp.ndarray, jnp.ndarray],
     D: int,
     existing: PodArrays,
+    hard_weight=1.0,
+    ecfg=None,
 ):
     """Per-predicate [P, N] component masks (PredicateFailureReason analog) —
     module-level jit so repeated extender Filter calls hit the compile cache."""
     from ..ops.assign import mask_components
 
     uk, ev = keys
-    cyc = build_cycle(tables, existing, uk, ev, D)
+    cyc = build_cycle(tables, existing, uk, ev, D, hard_weight,
+                      ecfg or default_engine_config())
     return mask_components(tables, cyc, pending)
 
 

@@ -26,13 +26,17 @@ from ..state.cache import Snapshot
 
 
 @functools.partial(jax.jit, static_argnums=(5,))
-def _preempt(tables, cyc_existing, cls, nnr, prio, D, keys):
+def _preempt(tables, cyc_existing, cls, nnr, prio, D, keys, pdb_blocked,
+             hard_weight, ecfg):
     from ..ops.lattice import build_cycle
 
     uk, ev = keys
     existing = cyc_existing
-    cyc = build_cycle(tables, existing, uk, ev, D)
-    return preempt_for_pod(tables, cyc, existing, cls, nnr, prio, D)
+    # the what-if must apply the SAME plugin composition as the live path —
+    # a filter the config disabled must not block preemption candidates
+    cyc = build_cycle(tables, existing, uk, ev, D, hard_weight, ecfg)
+    return preempt_for_pod(tables, cyc, existing, cls, nnr, prio, D,
+                           pdb_blocked)
 
 
 class CacheEvictor:
@@ -53,10 +57,47 @@ class CacheEvictor:
 
 
 class Preemptor:
-    def __init__(self, evictor: Optional[CacheEvictor] = None) -> None:
+    def __init__(self, evictor: Optional[CacheEvictor] = None,
+                 pdb_source: Optional[Callable[[], list]] = None) -> None:
         self.evictor = evictor or CacheEvictor()
+        # pdb_source() → iterable of (namespace, LabelSelector,
+        # disruptions_allowed) triples — the PDB lister the reference hands to
+        # genericScheduler (factory.go wires a policy lister). Victims whose
+        # eviction would violate a PDB (allowed ≤ 0) become the what-if's
+        # pdb_blocked bits (filterPodsWithPDBViolation semantics).
+        self.pdb_source = pdb_source
         self.attempts = 0
         self.successes = 0
+        self.last_pdb_violations = 0
+
+    def _pdb_blocked(self, scheduler, snap: Snapshot):
+        import numpy as np
+
+        E = len(snap.existing_keys)
+        blocked = np.zeros((max(E, 1),), bool)
+        if self.pdb_source is None:
+            return blocked
+        from ..api.semantics import selector_matches
+
+        # reference-faithful matching (generic_scheduler.go:1080-1098):
+        # a nil/EMPTY selector matches NOTHING, and unlabeled pods are
+        # skipped ("A pod with no labels will not match any PDB")
+        pdbs = [(ns, sel, allowed) for ns, sel, allowed in self.pdb_source()
+                if allowed <= 0 and sel is not None
+                and getattr(sel, "requirements", ())]
+        if not pdbs:
+            return blocked
+        for i, key in enumerate(snap.existing_keys):
+            if not key:
+                continue
+            pod = scheduler.cache.get_pod(key)
+            if pod is None or not pod.labels:
+                continue
+            for ns, sel, _ in pdbs:
+                if ns == pod.namespace and selector_matches(sel, pod.labels):
+                    blocked[i] = True
+                    break
+        return blocked
 
     def try_preempt(self, scheduler, pod: Pod, attempts: int,
                     snap: Snapshot, now: float) -> bool:
@@ -85,10 +126,21 @@ class Preemptor:
 
         uk = jnp.int32(enc.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
         ev = jnp.int32(enc.vocabs.label_vals.get(""))
+        import numpy as np
+
+        blocked = self._pdb_blocked(scheduler, snap)
+        pdb_arr = np.zeros((snap.existing.valid.shape[0],), bool)
+        pdb_arr[: blocked.shape[0]] = blocked
+        from ..ops.lattice import default_engine_config
+
         res: PreemptResult = _preempt(
             snap.tables, snap.existing,
             snap.pending.cls[row], snap.pending.node_name_req[row],
             jnp.int32(pod.priority), snap.dims.D, (uk, ev),
+            jnp.asarray(pdb_arr),
+            jnp.float32(getattr(scheduler, "hard_pod_affinity_weight", 1.0)),
+            getattr(scheduler, "engine_config", None)
+            or default_engine_config(),
         )
         node_idx = int(jax.device_get(res.node))
         if node_idx < 0:
@@ -108,6 +160,7 @@ class Preemptor:
         for vk in victim_keys:
             self.evictor.evict(scheduler, vk)
 
+        self.last_pdb_violations = int(jax.device_get(res.n_pdb_violations))
         node_name = snap.node_order[node_idx]
         scheduler.queue.add_nominated(pod.key, node_name)
         # cache changed → move event; requeue the preemptor for a prompt retry

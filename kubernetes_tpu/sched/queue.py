@@ -54,7 +54,12 @@ def _active_key(e: _Entry) -> Tuple[int, int]:
 class PriorityQueue:
     """Thread-safe. All mutation under one lock, as the reference's `p.lock`."""
 
-    def __init__(self) -> None:
+    def __init__(self, initial_backoff: float = INITIAL_BACKOFF,
+                 max_backoff: float = MAX_BACKOFF) -> None:
+        # podInitialBackoffSeconds/podMaxBackoffSeconds
+        # (apis/config/types.go:96-101) — config-surface overridable
+        self.initial_backoff = initial_backoff
+        self.max_backoff = max_backoff
         self._mu = threading.Lock()
         self._cond = threading.Condition(self._mu)
         self._seq = itertools.count()
@@ -124,11 +129,11 @@ class PriorityQueue:
     def _backoff_for(self, e: _Entry) -> float:
         return self.backoff_duration(e.attempts)
 
-    @staticmethod
-    def backoff_duration(attempts: int) -> float:
-        """Exponential: 1s * 2^(attempts-1) capped at 10s (getBackoffTime,
-        scheduling_queue.go:60-64)."""
-        return min(INITIAL_BACKOFF * (2.0 ** max(attempts - 1, 0)), MAX_BACKOFF)
+    def backoff_duration(self, attempts: int) -> float:
+        """Exponential: initial * 2^(attempts-1) capped at max (getBackoffTime,
+        scheduling_queue.go:60-64; bounds from config types.go:96-101)."""
+        return min(self.initial_backoff * (2.0 ** max(attempts - 1, 0)),
+                   self.max_backoff)
 
     def update(self, pod: Pod, now: float = 0.0) -> None:
         """Update (scheduling_queue.go:331): spec changes reset the pod's

@@ -107,6 +107,17 @@ class Scheduler:
         # The device-evaluated points run inside the fused cycle; None keeps
         # the plain fast path.
         self.framework = framework
+        # hardPodAffinitySymmetricWeight (apis/config/types.go:70); set from
+        # KubeSchedulerConfiguration by the server wiring
+        self.hard_pod_affinity_weight = 1.0
+        # fused-engine plugin composition (ops/lattice.py EngineConfig);
+        # None = the default provider's set
+        self.engine_config = None
+        # configured score plugins outside the fused set reach the dispatch
+        # as a static per-class bias (framework/plugins.py extra_score_plugins)
+        from ..framework.plugins import extra_score_plugins
+
+        self._extra_score = extra_score_plugins(framework)
         # key → (attempts, CycleState, node_name, original pod, binder_ext)
         self._waiting_meta: Dict[str, Tuple] = {}
         self.waiting_bind_errors = 0  # bind failures on the waiting-release path
@@ -215,9 +226,14 @@ class Scheduler:
 
         pending = [p for p, _ in batch]
         snap, keys = self._snapshot_keys(pending)
+        extras = tuple(p for p, _ in self._extra_score)
         res = _schedule_batch(snap.tables, snap.pending, keys, snap.dims.D,
                               snap.existing,
-                              has_node_name=snap.dims.has_node_name)
+                              has_node_name=snap.dims.has_node_name,
+                              hard_weight=self.hard_pod_affinity_weight,
+                              ecfg=self.engine_config,
+                              extra_plugins=extras,
+                              extra_weights=tuple(w for _, w in self._extra_score))
         node_idx = jax.device_get(res.node)
 
         failures: List[Tuple[Pod, int]] = []
@@ -270,9 +286,16 @@ class Scheduler:
             return  # stale queue entry (skipPodSchedule)
 
         snap, keys = self._snapshot_keys([pod])
-        # one dispatch: infeasible nodes are -inf in the score matrix
+        # one dispatch: infeasible nodes are -inf in the score matrix; the
+        # extender path must see the SAME composed scores as the fused path
+        from ..ops.lattice import default_engine_config
+
         raw = jax.device_get(_scores(
-            snap.tables, snap.pending, keys, snap.dims.D, snap.existing))[0]
+            snap.tables, snap.pending, keys, snap.dims.D, snap.existing,
+            jnp.float32(self.hard_pod_affinity_weight),
+            self.engine_config or default_engine_config(),
+            tuple(p for p, _ in self._extra_score),
+            tuple(w for _, w in self._extra_score)))[0]
 
         nodes_by_name = {n.name: n for n in self.cache.nodes()}
         feasible: List[str] = []

@@ -85,18 +85,72 @@ class SchedulerServer:
                  cycle_interval: float = 0.05,
                  batch_window: float = 0.02,
                  leader_elect: bool = False,
-                 volume_binding: bool = True):
+                 volume_binding: bool = True,
+                 config=None):
         from kubernetes_tpu.state.dims import Dims
+
+        # ComponentConfig / Policy surface (apis/config/types.go:45-112 →
+        # sched/config.py): a config file/dict drives scheduler name, plugin
+        # composition + weights, extenders, backoff bounds, feature gates,
+        # preemption, and leader election.
+        self.config = None
+        framework = None
+        extenders = ()
+        queue = None
+        if config is not None:
+            from kubernetes_tpu.extender.client import HTTPExtender
+            from kubernetes_tpu.sched.config import (
+                KubeSchedulerConfiguration, load_config)
+            from kubernetes_tpu.sched.queue import PriorityQueue
+
+            self.config = (config if isinstance(config, KubeSchedulerConfiguration)
+                           else load_config(config))
+            self.config.apply_feature_gates()
+            scheduler_name = self.config.scheduler_name
+            framework = self.config.build_framework()
+            extenders = tuple(HTTPExtender(e) for e in self.config.extenders)
+            queue = PriorityQueue(
+                initial_backoff=self.config.pod_initial_backoff_seconds,
+                max_backoff=self.config.pod_max_backoff_seconds)
+            leader_elect = leader_elect or self.config.leader_election.leader_elect
 
         self.client = client
         self.recorder = EventRecorder(client, component=scheduler_name)
         self.scheduler = scheduler or Scheduler(
             binder=APIBinder(client), scheduler_name=scheduler_name,
+            queue=queue,
+            framework=framework,
+            extenders=extenders,
             # shape floor: tiny waves share one compiled (P,N,E) signature
             # instead of recompiling at every power-of-two batch size
             base_dims=Dims(N=64, P=128, E=512))
         if self.scheduler.binder is None:
             self.scheduler.binder = APIBinder(client)
+        if self.config is not None:
+            self.scheduler.hard_pod_affinity_weight = float(
+                self.config.hard_pod_affinity_symmetric_weight)
+            # the fused engines honor the plugin composition through traced
+            # per-component weights/flags (ops/lattice.py EngineConfig)
+            self.scheduler.engine_config = self.config.engine_config()
+            # NodeLabel needs vocab ids for its configured keys; intern them
+            # now so the ids are stable before any node arrives. A caller-
+            # supplied Scheduler keeps its own framework (possibly None).
+            fw = self.scheduler.framework
+            for pl in (fw.score_plugins if fw is not None else ()):
+                if type(pl).__name__ == "NodeLabel":
+                    keys = self.scheduler.encoder.vocabs.label_keys
+                    pl._present_ids = tuple(keys.intern(k) for k in pl.present)
+                    pl._absent_ids = tuple(keys.intern(k) for k in pl.absent)
+        if config is not None and not self.config.disable_preemption \
+                and scheduler is None:
+            from kubernetes_tpu.sched.preemption import Preemptor
+
+            # PDB lister for the preemption what-if
+            # (filterPodsWithPDBViolation inputs) — served from the PDB
+            # informer cache wired in start(), like the reference's policy
+            # lister, never a synchronous LIST on the preemption hot path
+            self.scheduler.preemptor = Preemptor(
+                pdb_source=lambda: list(self._pdb_cache.values()))
         self.cycle_interval = cycle_interval
         # debounce: when pods flood in, wait this long so one batched device
         # wave absorbs them instead of many tiny waves (adds at most this
@@ -107,6 +161,8 @@ class SchedulerServer:
         self.volume_binding = volume_binding
         self.volume_binder = None
         self.pvc_informer = self.pv_informer = self.sc_informer = None
+        self.pdb_informer = None
+        self._pdb_cache: Dict[str, tuple] = {}  # key → (ns, selector, allowed)
         self._waiting_on_volumes: set = set()  # pod keys parked on PVCs
         self._creation_seq = 0
         self._stop = threading.Event()
@@ -182,7 +238,34 @@ class SchedulerServer:
 
     # -- lifecycle ----------------------------------------------------------- #
 
+    def _on_pdb(self, obj: Obj) -> None:
+        from kubernetes_tpu.api.v1 import _label_selector
+
+        m = obj.get("metadata", {})
+        key = f"{m.get('namespace', 'default')}/{m.get('name', '')}"
+        self._pdb_cache[key] = (
+            m.get("namespace", "default"),
+            _label_selector(obj.get("spec", {}).get("selector")),
+            int(obj.get("status", {}).get("disruptionsAllowed", 0)),
+        )
+
+    def _on_pdb_delete(self, obj: Obj) -> None:
+        m = obj.get("metadata", {})
+        self._pdb_cache.pop(
+            f"{m.get('namespace', 'default')}/{m.get('name', '')}", None)
+
     def start(self) -> "SchedulerServer":
+        if self.scheduler.preemptor is not None \
+                and getattr(self.scheduler.preemptor, "pdb_source", None) \
+                is not None:
+            self.pdb_informer = SharedInformer(
+                self.client.poddisruptionbudgets)
+            self.pdb_informer.add_handlers(
+                on_add=self._on_pdb,
+                on_update=lambda old, new: self._on_pdb(new),
+                on_delete=self._on_pdb_delete)
+            self.pdb_informer.start()
+            self.pdb_informer.wait_for_sync()
         self.pod_informer = SharedInformer(self.client.pods)
         self.pod_informer.add_handlers(on_add=self._on_pod_add,
                                        on_update=self._on_pod_update,
@@ -207,7 +290,8 @@ class SchedulerServer:
         self._stop.set()
         if self.elector is not None:
             self.elector.stop()
-        for inf in (self.pod_informer, self.node_informer):
+        for inf in (self.pod_informer, self.node_informer,
+                    self.pdb_informer):
             if inf is not None:
                 inf.stop()
         for t in self._threads:

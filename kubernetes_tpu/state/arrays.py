@@ -53,6 +53,7 @@ class NodeArrays(NamedTuple):
     port_pair_any: Array  # [N, PWp] u32 — (proto,port) used by any pod (any IP)
     port_pair_wild: Array # [N, PWp] u32 — (proto,port) used with wildcard IP
     port_triple: Array    # [N, PWt] u32 — (proto,port,ip) exact triples in use
+    img_words: Array      # [N, IW] u32 — image-presence bitset (ImageLocality)
 
 
 class ReqTable(NamedTuple):
@@ -139,6 +140,9 @@ class PodClassTable(NamedTuple):
     tsc_key: Array      # [SC, TS] i32 topo-key index
     tsc_maxskew: Array  # [SC, TS] i32
     tsc_hard: Array     # [SC, TS] bool (DoNotSchedule)
+    ssel_terms: Array   # [SC, SS] i32 → TermTable (SelectorSpread owners), -1 pad
+    img_ids: Array      # [SC, CI] i32 → image vocab (ImageLocality), -1 pad
+    lim_rid: Array      # [SC] i32 → ReqTable (container limits), -1 none
 
 
 class PodArrays(NamedTuple):
@@ -154,6 +158,14 @@ class PodArrays(NamedTuple):
     node_name_req: Array # [P] i32 spec.nodeName as name id, -1 none
 
 
+class ImageTable(NamedTuple):
+    """Interned container images: size in KiB per image id (ImageLocality;
+    nodeinfo ImageStateSummary.Size analog — NumNodes is derived on device
+    from NodeArrays.img_words so it stays patch-friendly)."""
+
+    size_kib: Array  # [IMG] i32
+
+
 class ClusterTables(NamedTuple):
     """Everything static-per-cycle bundled for the jitted lattice fns."""
 
@@ -165,3 +177,5 @@ class ClusterTables(NamedTuple):
     portsets: PortSetTable
     terms: TermTable
     classes: PodClassTable
+    images: ImageTable
+    zone_keys: Array  # [2] i32 topo-key ids (modern, legacy zone label), -1 absent

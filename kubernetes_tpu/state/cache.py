@@ -432,6 +432,7 @@ class SchedulerCache:
             "portsets": len(encoder.portset_reg),
             "terms": len(encoder.term_reg),
             "classes": len(encoder.class_reg),
+            "images": len(encoder.vocabs.images),
         }
 
     def _existing_pod_arrays(self, d: Dims) -> PodArrays:
@@ -499,6 +500,8 @@ class SchedulerCache:
             portsets=encoder.build_portset_table(d),
             terms=encoder.build_term_table(d),
             classes=encoder.build_class_table(d),
+            images=encoder.build_image_table(d),
+            zone_keys=encoder.build_zone_keys(),
         )
         pe = encoder.build_pod_arrays(list(pending), d, self._node_slot,
                                       capacity=d.P)
@@ -562,6 +565,7 @@ class SchedulerCache:
                 "portsets": encoder.build_portset_table,
                 "terms": encoder.build_term_table,
                 "classes": encoder.build_class_table,
+                "images": encoder.build_image_table,
             }
             tables = tables._replace(**{
                 k: jax.device_put(builders[k](d))

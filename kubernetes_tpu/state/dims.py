@@ -57,6 +57,10 @@ class Dims:
     PAT: int = 2      # preferred pod-affinity terms per pod
     PAN: int = 2      # preferred pod-anti-affinity terms per pod
     TS: int = 2       # topology-spread constraints per pod
+    SS: int = 2       # SelectorSpread owner selectors per pod
+    CI: int = 4       # container images per pod (ImageLocality)
+    IMG: int = 8      # interned container images
+    IW: int = 1       # image-presence bitset words (32 images per word)
     S: int = 8        # interned pod-selector term table size
     SR: int = 8       # distinct request vectors
     SL: int = 8       # distinct pod label sets

@@ -48,6 +48,11 @@ from .vocab import Vocab, VocabSet, parse_label_int
 I32 = np.int32
 U32 = np.uint32
 
+# GetZoneKey's label precedence (pkg/util/node): the modern topology label,
+# falling back to the pre-1.17 failure-domain beta label
+ZONE_TOPO_KEYS = ("topology.kubernetes.io/zone",
+                  "failure-domain.beta.kubernetes.io/zone")
+
 
 def _set_bit(words: np.ndarray, idx: int) -> None:
     words[idx >> 5] |= U32(1) << U32(idx & 31)
@@ -97,6 +102,7 @@ class Encoder:
         self._max_node_labels = 1
         self._max_node_taints = 1
         self._node_domains_done: Dict[int, tuple] = {}
+        self.image_sizes: List[int] = []  # KiB, parallel to vocabs.images
 
     # ---------------- sub-object interning ---------------- #
 
@@ -168,6 +174,17 @@ class Encoder:
 
     # ---------------- class interning ---------------- #
 
+    def image_id(self, name: str, size_kib: int = 0) -> int:
+        """Intern a container image; first size seen wins (ImageStateSummary
+        keeps one size per image, nodeinfo/node_info.go image states)."""
+        before = len(self.vocabs.images)
+        i = self.vocabs.images.intern(name)
+        if i == before:
+            self.image_sizes.append(size_kib)
+        elif size_kib and not self.image_sizes[i]:
+            self.image_sizes[i] = size_kib
+        return i
+
     def class_id(self, p: Pod) -> int:
         ns_id = self.vocabs.namespaces.intern(p.namespace)
         rid = self.req_id(p.requests)
@@ -198,8 +215,24 @@ class Encoder:
             )
             for c in p.topology_spread
         )
+        # SelectorSpread owner selectors: countMatchingPods requires a pod to
+        # match EVERY owner selector (selector_spreading.go:198-218), so the
+        # conjunction is interned as ONE term with an empty topology key
+        # (counting is per-node via CNT; zone weighting uses the well-known
+        # zone keys, not the term's key)
+        ssel = ()
+        if p.spread_selectors:
+            all_reqs = tuple(r for s in p.spread_selectors
+                             for r in s.requirements)
+            ssel = (self.term_id(LabelSelector(all_reqs), (p.namespace,), ""),)
+            for zk in ZONE_TOPO_KEYS:  # zone-weighted reduce needs zone domains
+                self.vocabs.topo_keys.intern(zk)
+                self.vocabs.label_keys.intern(zk)
+        imgs = tuple(self.image_id(nm) for nm in p.images)
+        lim = (self.req_id(p.limits)
+               if (p.limits.milli_cpu or p.limits.memory_kib) else -1)
         spec = (ns_id, rid, ls, nsel, aff_active, nterms, pterms, tol, ports,
-                aff, anti, paff, panti, tsc)
+                aff, anti, paff, panti, tsc, ssel, imgs, lim)
         before = len(self.class_reg)
         cid = self.class_reg.intern(spec)
         if cid == before:
@@ -219,6 +252,8 @@ class Encoder:
             self.vocabs.label_vals.intern(t.value)
         for name, _ in n.allocatable.scalars:
             self.vocabs.resources.intern(name)
+        for img, size in n.images_kib.items():
+            self.image_id(img, size)
         self._max_node_labels = max(self._max_node_labels, len(n.labels))
         self._max_node_taints = max(self._max_node_taints, len(n.taints))
         _evict_half(self._node_seen, 1 << 18)
@@ -332,6 +367,10 @@ class Encoder:
             PAT=mx([len(s[11]) for s in self._class_spec]),
             PAN=mx([len(s[12]) for s in self._class_spec]),
             TS=mx([len(s[13]) for s in self._class_spec]),
+            SS=mx([len(s[14]) for s in self._class_spec]),
+            CI=mx([len(s[15]) for s in self._class_spec]),
+            IMG=max(len(self.vocabs.images), 1),
+            IW=(len(self.vocabs.images) + 31) // 32 or 1,
             S=max(len(self.term_reg), 1),
             SR=max(len(self.req_reg), 1),
             SL=max(len(self.labelset_reg), 1),
@@ -458,10 +497,12 @@ class Encoder:
             panti_terms=z((SC, d.PAN), -1), panti_w=z((SC, d.PAN)),
             tsc_term=z((SC, d.TS), -1), tsc_key=z((SC, d.TS), -1),
             tsc_maxskew=z((SC, d.TS)), tsc_hard=z((SC, d.TS), False, bool),
+            ssel_terms=z((SC, d.SS), -1), img_ids=z((SC, d.CI), -1),
+            lim_rid=z((SC,), -1),
         )
         for i, spec in enumerate(self._class_spec):
             (ns_id, rid, ls, nsel, aff_active, nterms, pterms, tol, ports,
-             aff, anti, paff, panti, tsc) = spec
+             aff, anti, paff, panti, tsc, ssel, imgs, lim) = spec
             t["valid"][i] = True
             t["ns"][i], t["rid"][i], t["labelset"][i] = ns_id, rid, ls
             t["nsel_term"][i] = nsel
@@ -482,7 +523,26 @@ class Encoder:
             for ti, (x, k, skew, hard) in enumerate(tsc):
                 t["tsc_term"][i, ti], t["tsc_key"][i, ti] = x, k
                 t["tsc_maxskew"][i, ti], t["tsc_hard"][i, ti] = skew, hard
+            for ti, x in enumerate(ssel):
+                t["ssel_terms"][i, ti] = x
+            for ti, x in enumerate(imgs):
+                t["img_ids"][i, ti] = x
+            t["lim_rid"][i] = lim
         return PodClassTable(**t)
+
+    def build_image_table(self, d: Dims) -> "ImageTable":
+        from .arrays import ImageTable
+
+        size = np.zeros((d.IMG,), I32)
+        for i, s in enumerate(self.image_sizes):
+            size[i] = s
+        return ImageTable(size_kib=size)
+
+    def build_zone_keys(self) -> np.ndarray:
+        """[2] i32: topo-key ids of the modern / legacy zone labels
+        (GetZoneKey precedence), -1 when not interned."""
+        return np.array([self.vocabs.topo_keys.get(k) for k in ZONE_TOPO_KEYS],
+                        I32)
 
     def encode_node_row(
         self, arrays: NodeArrays, i: int, n: Node, pods_on_node: Sequence[Pod],
@@ -520,6 +580,9 @@ class Encoder:
             arrays.taint_keys[i, ti] = v.label_keys.intern(t.key)
             arrays.taint_vals[i, ti] = v.label_vals.intern(t.value)
             arrays.taint_effects[i, ti] = int(t.effect)
+        arrays.img_words[i] = 0
+        for img, size in n.images_kib.items():
+            _set_bit(arrays.img_words[i], self.image_id(img, size))
         self.register_node_domains(n)
         arrays.topo[i] = -1
         arrays.domain[i] = -1
@@ -574,6 +637,7 @@ class Encoder:
             port_pair_any=np.zeros((N, d.PWp), U32),
             port_pair_wild=np.zeros((N, d.PWp), U32),
             port_triple=np.zeros((N, d.PWt), U32),
+            img_words=np.zeros((N, d.IW), U32),
         )
 
     def build_node_arrays(
@@ -643,6 +707,8 @@ class Encoder:
             portsets=self.build_portset_table(d),
             terms=self.build_term_table(d),
             classes=self.build_class_table(d),
+            images=self.build_image_table(d),
+            zone_keys=self.build_zone_keys(),
         )
         ex = self.build_pod_arrays(existing, d, node_index, capacity=d.E)
         pe = self.build_pod_arrays(pending, d, node_index, capacity=d.P)

@@ -76,3 +76,4 @@ class VocabSet:
         self.topo_keys = Vocab()  # topology keys referenced by any term/constraint
         self.port_pairs = Vocab()  # (protocol, port)
         self.port_triples = Vocab()  # (protocol, port, ip) with ip != wildcard
+        self.images = Vocab()  # container image names (ImageLocality)

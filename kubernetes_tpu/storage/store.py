@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from kubernetes_tpu.machinery import errors, meta
 from kubernetes_tpu.machinery import watch as mwatch
 from kubernetes_tpu.storage import native
+from kubernetes_tpu.storage.cacher import CachedEvent, WatchCache
 
 Obj = Dict[str, Any]
 Predicate = Optional[Callable[[Obj], bool]]
@@ -48,6 +49,10 @@ class Storage:
         # before this watcher's horizon and never delivered to it
         self._watchers: List[Tuple[str, mwatch.Watch, Predicate, int]] = []
         self._dispatched_rev = self.kv.rev()
+        # Cacher tier (storage/cacher.py ⇔ cacher.go:309): the pump decodes
+        # each event once into this ring; watcher catch-up replays from it so
+        # storage reads stay independent of watcher count
+        self.watch_cache = WatchCache(horizon=self._dispatched_rev)
         self._stop = threading.Event()
         self._pump = threading.Thread(target=self._dispatch_loop,
                                       name="storage-watch-pump", daemon=True)
@@ -161,34 +166,55 @@ class Storage:
             since = int(since_rv) if since_rv not in ("", "0") else self.kv.rev()
             # catch-up: replay history before going live under the same lock
             # the pump uses, so no event is missed or duplicated; the pump
-            # delivers everything > max(since, _dispatched_rev)
-            try:
-                history = self.kv.events_since(since, prefix)
-            except native.CompactedError:
-                raise errors.new_gone(
-                    f"too old resource version: {since} "
-                    f"(compacted at {self.kv.compacted_rev()})")
-            for ev in history:
-                if ev.rev > self._dispatched_rev:
-                    break  # the pump will deliver the rest
-                self._send(w, ev, predicate)
+            # delivers everything > max(since, _dispatched_rev). The replay
+            # is served from the watch cache whenever `since` is within its
+            # horizon — no storage read per watcher (cacher.go:369-374)
+            cached = self.watch_cache.events_since(since, prefix)
+            if cached is not None:
+                for ce in cached:
+                    if ce.rev > self._dispatched_rev:
+                        break
+                    self._deliver(w, ce, predicate)
+            else:
+                try:
+                    history = self.kv.events_since(since, prefix)
+                except native.CompactedError:
+                    raise errors.new_gone(
+                        f"too old resource version: {since} "
+                        f"(compacted at {self.kv.compacted_rev()})")
+                for ev in history:
+                    if ev.rev > self._dispatched_rev:
+                        break  # the pump will deliver the rest
+                    self._send(w, ev, predicate)
             self._watchers.append((prefix, w, predicate,
                                    max(since, self._dispatched_rev)))
         return w
 
     @staticmethod
-    def _send(w: mwatch.Watch, ev: native.KVEvent, predicate: Predicate,
-              timeout: float = 0.0) -> None:
-        obj = _decode(ev.value, ev.rev)
-        if predicate is not None and not predicate(obj):
-            return
+    def _to_cached(ev: native.KVEvent) -> CachedEvent:
         typ = {native.EVENT_CREATE: mwatch.ADDED,
                native.EVENT_PUT: mwatch.MODIFIED,
                native.EVENT_DELETE: mwatch.DELETED}[ev.type]
+        return CachedEvent(rev=ev.rev, type=typ, key=ev.key,
+                           obj=_decode(ev.value, ev.rev))
+
+    @classmethod
+    def _send(cls, w: mwatch.Watch, ev: native.KVEvent, predicate: Predicate,
+              timeout: float = 0.0) -> None:
+        cls._deliver(w, cls._to_cached(ev), predicate, timeout)
+
+    @staticmethod
+    def _deliver(w: mwatch.Watch, ce: CachedEvent, predicate: Predicate,
+                 timeout: float = 0.0) -> None:
+        if predicate is not None and not predicate(ce.obj):
+            return
+        # watchers receive a copy so one consumer's mutation can't leak into
+        # another's view of the shared decoded event
+        obj = meta.deep_copy(ce.obj)
         # non-blocking from the dispatcher: a watcher that cannot keep up is
         # terminated (send stops it on Full), never allowed to stall the
         # event path for everyone else (cacher.go forgetWatcher semantics)
-        w.send(mwatch.Event(typ, obj), timeout=timeout)
+        w.send(mwatch.Event(ce.type, obj), timeout=timeout)
 
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
@@ -210,16 +236,25 @@ class Storage:
                         w.stop()
                     self._watchers.clear()
                     self._dispatched_rev = self.kv.rev()
+                    # the compacted-away events never reached the ring: the
+                    # cache has a GAP, so its window must restart at now —
+                    # otherwise a later resume would be served an incomplete
+                    # history instead of falling through to a 410
+                    self.watch_cache = WatchCache(
+                        horizon=self._dispatched_rev)
                 continue
             with self._watch_mu:
+                cached = [self._to_cached(ev) for ev in events]  # decode ONCE
+                for ce in cached:
+                    self.watch_cache.add(ce)
                 live = []
                 for prefix, w, pred, since in self._watchers:
                     if w.stopped:
                         continue
                     live.append((prefix, w, pred, since))
-                    for ev in events:
-                        if ev.rev > since and ev.key.startswith(prefix):
-                            self._send(w, ev, pred)
+                    for ce in cached:
+                        if ce.rev > since and ce.key.startswith(prefix):
+                            self._deliver(w, ce, pred)
                 self._watchers = live
                 if events:
                     self._dispatched_rev = max(e.rev for e in events)

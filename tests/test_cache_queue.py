@@ -132,11 +132,16 @@ class TestPriorityQueue:
         assert q.lengths() == (1, 0, 0)
 
     def test_exponential_backoff_caps_at_max(self):
-        assert PriorityQueue.backoff_duration(1) == INITIAL_BACKOFF
-        assert PriorityQueue.backoff_duration(2) == 2.0
-        assert PriorityQueue.backoff_duration(4) == 8.0
-        assert PriorityQueue.backoff_duration(5) == MAX_BACKOFF   # 16 → cap
-        assert PriorityQueue.backoff_duration(9) == MAX_BACKOFF
+        q = PriorityQueue()
+        assert q.backoff_duration(1) == INITIAL_BACKOFF
+        assert q.backoff_duration(2) == 2.0
+        assert q.backoff_duration(4) == 8.0
+        assert q.backoff_duration(5) == MAX_BACKOFF   # 16 → cap
+        assert q.backoff_duration(9) == MAX_BACKOFF
+        # config-surface bounds (apis/config/types.go:96-101)
+        q2 = PriorityQueue(initial_backoff=2.0, max_backoff=4.0)
+        assert q2.backoff_duration(1) == 2.0
+        assert q2.backoff_duration(3) == 4.0
 
     def test_unschedulable_flushed_after_interval(self):
         q = PriorityQueue()

@@ -1,0 +1,302 @@
+"""Config surface: KubeSchedulerConfiguration (ComponentConfig) + legacy
+Policy JSON loading, plugin composition, weights, extenders, backoff bounds,
+feature gates, percentageOfNodesToScore.
+
+Reference: /root/reference/pkg/scheduler/apis/config/types.go:45-112 (fields),
+:229-231 (percentage default), factory.go:309 (Policy composition),
+legacy_types.go (Policy/Extender schemas).
+"""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.sched.config import (
+    KubeSchedulerConfiguration,
+    PREDICATE_TO_PLUGIN,
+    PRIORITY_TO_PLUGIN,
+    apply_policy,
+    load_config,
+)
+
+YAML_CONFIG = """
+apiVersion: kubescheduler.config.k8s.io/v1alpha1
+kind: KubeSchedulerConfiguration
+schedulerName: tpu-scheduler
+disablePreemption: true
+percentageOfNodesToScore: 70
+hardPodAffinitySymmetricWeight: 3
+podInitialBackoffSeconds: 2
+podMaxBackoffSeconds: 20
+leaderElection:
+  leaderElect: true
+featureGates:
+  EvenPodsSpread: false
+plugins:
+  score:
+    disabled:
+      - ImageLocality
+    enabled:
+      - name: NodeResourcesMostAllocated
+        weight: 5
+  filter:
+    disabled:
+      - NodePorts
+extenders:
+  - urlPrefix: http://127.0.0.1:9998/scheduler
+    filterVerb: filter
+    prioritizeVerb: prioritize
+    weight: 2
+    nodeCacheCapable: true
+    ignorable: true
+pluginConfig:
+  - name: NodeLabel
+    args:
+      present: ["zone"]
+"""
+
+
+def test_yaml_config_loads_fields():
+    cfg = load_config(YAML_CONFIG)
+    assert cfg.scheduler_name == "tpu-scheduler"
+    assert cfg.disable_preemption is True
+    assert cfg.percentage_of_nodes_to_score == 70
+    assert cfg.hard_pod_affinity_symmetric_weight == 3
+    assert cfg.pod_initial_backoff_seconds == 2
+    assert cfg.pod_max_backoff_seconds == 20
+    assert cfg.leader_election.leader_elect is True
+    assert cfg.feature_gates == {"EvenPodsSpread": False}
+    assert cfg.plugin_config["NodeLabel"] == {"present": ["zone"]}
+    assert len(cfg.extenders) == 1
+    ext = cfg.extenders[0]
+    assert ext.url_prefix.endswith(":9998/scheduler")
+    assert ext.weight == 2 and ext.node_cache_capable and ext.ignorable
+
+
+def test_plugin_merge_semantics():
+    """enabled appends, disabled removes, weights carry
+    (apis/config/types.go:117-158)."""
+    cfg = load_config(YAML_CONFIG)
+    score = cfg.plugins.score.enabled
+    assert "ImageLocality" not in score
+    assert "NodeResourcesMostAllocated" in score
+    assert "NodeResourcesLeastAllocated" in score  # defaults kept
+    assert "NodePorts" not in cfg.plugins.filter.enabled
+    assert "NodeResourcesFit" in cfg.plugins.filter.enabled
+    assert cfg.score_weights["NodeResourcesMostAllocated"] == 5.0
+
+
+def test_star_disable_clears_defaults():
+    cfg = load_config({
+        "plugins": {"score": {"disabled": ["*"],
+                              "enabled": ["NodeResourcesMostAllocated"]}},
+    })
+    assert cfg.plugins.score.enabled == ["NodeResourcesMostAllocated"]
+
+
+def test_percentage_of_nodes_to_score_adaptive_default():
+    """generic_scheduler.go:450-469: 100% under 100 nodes; 50 − nodes/125
+    floored at 5 otherwise; explicit config wins."""
+    cfg = KubeSchedulerConfiguration()
+    assert cfg.effective_percentage_of_nodes_to_score(50) == 100
+    assert cfg.effective_percentage_of_nodes_to_score(1000) == 42
+    assert cfg.effective_percentage_of_nodes_to_score(125 * 50) == 5
+    explicit = KubeSchedulerConfiguration(percentage_of_nodes_to_score=70)
+    assert explicit.effective_percentage_of_nodes_to_score(5000) == 70
+
+
+def test_policy_json_composition():
+    policy = {
+        "kind": "Policy",
+        "apiVersion": "v1",
+        "predicates": [{"name": "PodFitsResources"},
+                       {"name": "PodToleratesNodeTaints"},
+                       {"name": "MatchInterPodAffinity"}],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 2},
+                       {"name": "SelectorSpreadPriority", "weight": 1}],
+        "extenders": [{"urlPrefix": "http://e/x", "filterVerb": "f"}],
+        "hardPodAffinitySymmetricWeight": 7,
+    }
+    cfg = load_config({"policy": policy})
+    assert cfg.plugins.filter.enabled == [
+        "NodeResourcesFit", "TaintToleration", "InterPodAffinity"]
+    assert cfg.plugins.score.enabled == [
+        "NodeResourcesLeastAllocated", "SelectorSpread"]
+    assert cfg.score_weights == {"NodeResourcesLeastAllocated": 2.0,
+                                 "SelectorSpread": 1.0}
+    assert cfg.hard_pod_affinity_symmetric_weight == 7
+    assert cfg.extenders[0].url_prefix == "http://e/x"
+
+
+def test_policy_file_via_algorithm_source(tmp_path):
+    pol = tmp_path / "policy.json"
+    pol.write_text(json.dumps({
+        "kind": "Policy",
+        "predicates": [{"name": "HostName"}],
+        "priorities": [{"name": "ImageLocalityPriority", "weight": 3}],
+    }))
+    cfg_file = tmp_path / "config.yaml"
+    cfg_file.write_text(
+        "kind: KubeSchedulerConfiguration\n"
+        "algorithmSource:\n"
+        "  policy:\n"
+        f"    file:\n      path: {pol}\n")
+    cfg = load_config(str(cfg_file))
+    assert cfg.plugins.filter.enabled == ["NodeName"]
+    assert cfg.plugins.score.enabled == ["ImageLocality"]
+    assert cfg.score_weights["ImageLocality"] == 3.0
+
+
+def test_name_tables_cover_reference_defaults():
+    """Every default-provider predicate/priority name maps
+    (algorithmprovider/defaults/register_{predicates,priorities}.go)."""
+    for name in ("PodFitsResources", "PodFitsHostPorts", "HostName",
+                 "MatchNodeSelector", "PodToleratesNodeTaints",
+                 "CheckNodeUnschedulable", "MatchInterPodAffinity"):
+        assert name in PREDICATE_TO_PLUGIN
+    for name in ("LeastRequestedPriority", "BalancedResourceAllocation",
+                 "SelectorSpreadPriority", "InterPodAffinityPriority",
+                 "NodeAffinityPriority", "TaintTolerationPriority",
+                 "ImageLocalityPriority", "NodePreferAvoidPodsPriority"):
+        assert name in PRIORITY_TO_PLUGIN
+
+
+def test_build_framework_honors_config():
+    cfg = load_config(YAML_CONFIG)
+    fw = cfg.build_framework()
+    names = [type(p).__name__ for p in fw.score_plugins]
+    assert "ImageLocality" not in names
+    assert "NodeResourcesMostAllocated" in names
+
+
+def test_bad_kind_rejected():
+    with pytest.raises(ValueError):
+        load_config({"kind": "Deployment"})
+    cfg = KubeSchedulerConfiguration()
+    with pytest.raises(ValueError):
+        apply_policy(cfg, {"kind": "NotAPolicy"})
+
+
+def test_scheduler_server_consumes_config():
+    """A config dict drives the LIVE server: scheduler name, plugin set,
+    queue backoff bounds, preemption toggle (cmd/kube-scheduler Run wiring)."""
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import Client
+    from kubernetes_tpu.sched.server import SchedulerServer
+
+    api = APIServer()
+    client = Client.local(api)
+    try:
+        srv = SchedulerServer(client, config={
+            "kind": "KubeSchedulerConfiguration",
+            "schedulerName": "cfg-sched",
+            "disablePreemption": True,
+            "podInitialBackoffSeconds": 3,
+            "podMaxBackoffSeconds": 30,
+            "plugins": {"score": {"disabled": ["ImageLocality"]}},
+        })
+        assert srv.scheduler.scheduler_name == "cfg-sched"
+        assert srv.scheduler.queue.initial_backoff == 3
+        assert srv.scheduler.queue.max_backoff == 30
+        assert srv.scheduler.preemptor is None  # disablePreemption
+        names = [type(p).__name__
+                 for p in srv.scheduler.framework.score_plugins]
+        assert "ImageLocality" not in names
+        assert srv.config.effective_percentage_of_nodes_to_score(5000) == 10
+    finally:
+        api.close()
+
+
+def test_engine_config_drives_fused_placement():
+    """The plugin composition must reach the FUSED engine, not just the
+    framework path: disabling a filter plugin admits otherwise-blocked nodes;
+    score weights flip spread (least-allocated) into packing (most-allocated)."""
+    import numpy as np
+
+    from kubernetes_tpu.api.types import (
+        Node, Pod, Resources, Taint, TaintEffect)
+    from kubernetes_tpu.sched.cycle import (
+        UNSCHEDULABLE_TAINT_KEY, _schedule_batch)
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.encode import Encoder
+    from kubernetes_tpu.sched.cycle import snapshot_with_keys
+
+    def run(cfg_dict, nodes, existing, pending):
+        cache = SchedulerCache()
+        for n in nodes:
+            cache.add_node(n)
+        for p in existing:
+            cache.add_pod(p)
+        enc = Encoder()
+        snap, keys = snapshot_with_keys(cache, enc, pending, None)
+        cfg = load_config(cfg_dict) if cfg_dict else None
+        res = _schedule_batch(
+            snap.tables, snap.pending, keys, snap.dims.D, snap.existing,
+            has_node_name=snap.dims.has_node_name,
+            ecfg=cfg.engine_config() if cfg else None)
+        idx = np.asarray(res.node)
+        return [snap.node_order[i] if i >= 0 else None
+                for i in idx[: len(pending)]]
+
+    tainted = Node(name="t0", taints=(Taint("gpu", "yes",
+                                            TaintEffect.NO_SCHEDULE),),
+                   allocatable=Resources.make(cpu="8", memory="16Gi", pods=10))
+    pod = Pod(name="p", requests=Resources.make(cpu="100m", memory="64Mi"))
+
+    # default: taint blocks the only node
+    assert run(None, [tainted], [], [pod]) == [None]
+    # config disables the TaintToleration filter → node admits the pod
+    no_taints = {"plugins": {"filter": {"disabled": ["TaintToleration"]}}}
+    assert run(no_taints, [tainted], [], [pod]) == ["t0"]
+
+    # scoring: n0 is heavily used; least-allocated (default) avoids it,
+    # most-allocated (bin packing) prefers it
+    n0 = Node(name="n0", allocatable=Resources.make(cpu="8", memory="16Gi",
+                                                    pods=20))
+    n1 = Node(name="n1", allocatable=Resources.make(cpu="8", memory="16Gi",
+                                                    pods=20))
+    heavy = Pod(name="h", requests=Resources.make(cpu="6", memory="12Gi"),
+                node_name="n0")
+    assert run(None, [n0, n1], [heavy], [pod]) == ["n1"]
+    packing = {"plugins": {"score": {
+        "disabled": ["NodeResourcesLeastAllocated",
+                     "NodeResourcesBalancedAllocation"],
+        "enabled": [{"name": "NodeResourcesMostAllocated", "weight": 1}]}}}
+    assert run(packing, [n0, n1], [heavy], [pod]) == ["n0"]
+
+
+def test_extra_score_plugin_reaches_fused_path():
+    """Score plugins without a fixed EngineConfig slot (NodeLabel here) must
+    still shape placement: the fused dispatch folds them in as a per-class
+    bias (framework/plugins.py extra_score_plugins)."""
+    import numpy as np
+
+    from kubernetes_tpu.api.types import Node, Pod, Resources
+    from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+    from kubernetes_tpu.sched.config import load_config
+
+    cfg = load_config({
+        "kind": "KubeSchedulerConfiguration",
+        "plugins": {"score": {"enabled": [{"name": "NodeLabel", "weight": 50}]}},
+        "pluginConfig": [{"name": "NodeLabel", "args": {"present": ["ssd"]}}],
+    })
+    fw = cfg.build_framework()
+    s = Scheduler(binder=RecordingBinder(), framework=fw)
+    s.engine_config = cfg.engine_config()
+    # resolve NodeLabel key ids against this scheduler's encoder (the
+    # SchedulerServer does this in its config wiring)
+    for pl in fw.score_plugins:
+        if type(pl).__name__ == "NodeLabel":
+            pl._present_ids = (s.encoder.vocabs.label_keys.intern("ssd"),)
+    s.on_node_add(Node(name="plain",
+                       allocatable=Resources.make(cpu="4", memory="8Gi",
+                                                  pods=10)))
+    s.on_node_add(Node(name="fast", labels={"ssd": "true"},
+                       allocatable=Resources.make(cpu="4", memory="8Gi",
+                                                  pods=10)))
+    s.on_pod_add(Pod(name="p",
+                     requests=Resources.make(cpu="100m", memory="64Mi")))
+    st = s.schedule_pending()
+    # without the NodeLabel bias the tie would break to the lower index
+    # ("plain"); the weighted label preference must pull it to "fast"
+    assert st.assignments.get("default/p") == "fast"

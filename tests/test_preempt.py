@@ -146,3 +146,193 @@ def test_no_candidate_when_pod_cannot_fit_even_empty():
     st = s.schedule_pending()
     assert st.unschedulable == 1
     assert s.preemptor.evictor.evicted == []
+
+
+# --------------------------------------------------------------------------- #
+# PDB-aware preemption (pickOneNodeForPreemption criterion 1 + the
+# violating-victims-first reprieve, generic_scheduler.go:903-928,1149-1156)
+# --------------------------------------------------------------------------- #
+
+
+def mksched_pdb(pdbs, clock=None):
+    clock = clock or FakeClock()
+    s = Scheduler(binder=RecordingBinder(), clock=clock,
+                  preemptor=Preemptor(pdb_source=lambda: pdbs))
+    return s, clock
+
+
+def test_pdb_protected_node_avoided():
+    """Criterion 1: with equal victims otherwise, the node whose victim's
+    eviction would violate a PDB loses to the unprotected node."""
+    sel = LabelSelector.of(match_labels={"app": "guarded"})
+    s, clock = mksched_pdb([("default", sel, 0)])
+    s.on_node_add(mknode("n0", cpu=1))
+    s.on_node_add(mknode("n1", cpu=1))
+    guarded = bound("guarded", "n0", cpu="900m", priority=5)
+    guarded.labels = {"app": "guarded"}
+    s.on_pod_add(guarded)
+    s.on_pod_add(bound("plain", "n1", cpu="900m", priority=5))
+    s.on_pod_add(Pod(name="vip", priority=100,
+                     requests=Resources.make(cpu="500m", memory="128Mi")))
+    s.schedule_pending()
+    assert s.preemptor.evictor.evicted == ["default/plain"]
+    assert s.queue.nominated_node("default/vip") == "n1"
+    assert s.preemptor.last_pdb_violations == 0
+
+
+def test_pdb_with_budget_left_does_not_block():
+    """disruptionsAllowed > 0 ⇒ eviction is not a violation."""
+    sel = LabelSelector.of(match_labels={"app": "guarded"})
+    s, clock = mksched_pdb([("default", sel, 2)])
+    s.on_node_add(mknode("n0", cpu=1))
+    guarded = bound("guarded", "n0", cpu="900m", priority=5)
+    guarded.labels = {"app": "guarded"}
+    s.on_pod_add(guarded)
+    s.on_pod_add(Pod(name="vip", priority=100,
+                     requests=Resources.make(cpu="500m", memory="128Mi")))
+    s.schedule_pending()
+    assert s.preemptor.evictor.evicted == ["default/guarded"]
+
+
+def test_pdb_violating_victim_reprieved_first():
+    """Two potential victims; evicting either frees enough. The PDB-protected
+    one must be reprieved (restored first) and the plain one evicted."""
+    sel = LabelSelector.of(match_labels={"app": "guarded"})
+    s, clock = mksched_pdb([("default", sel, 0)])
+    s.on_node_add(mknode("n0", cpu=2))
+    guarded = bound("guarded", "n0", cpu="900m", priority=5)
+    guarded.labels = {"app": "guarded"}
+    s.on_pod_add(guarded)
+    s.on_pod_add(bound("plain", "n0", cpu="900m", priority=5))
+    s.on_pod_add(Pod(name="vip", priority=100,
+                     requests=Resources.make(cpu="1", memory="128Mi")))
+    s.schedule_pending()
+    assert s.preemptor.evictor.evicted == ["default/plain"]
+    assert s.preemptor.last_pdb_violations == 0
+
+
+def test_unavoidable_pdb_violation_is_counted():
+    sel = LabelSelector.of(match_labels={"app": "guarded"})
+    s, clock = mksched_pdb([("default", sel, 0)])
+    s.on_node_add(mknode("n0", cpu=1))
+    guarded = bound("guarded", "n0", cpu="900m", priority=5)
+    guarded.labels = {"app": "guarded"}
+    s.on_pod_add(guarded)
+    s.on_pod_add(Pod(name="vip", priority=100,
+                     requests=Resources.make(cpu="500m", memory="128Mi")))
+    s.schedule_pending()
+    assert s.preemptor.evictor.evicted == ["default/guarded"]
+    assert s.preemptor.last_pdb_violations == 1
+
+
+def test_latest_start_time_tiebreak():
+    """Criterion 5: all else equal, prefer the node whose highest-priority
+    victim started LATEST (creation_index proxy)."""
+    s, clock = mksched()
+    s.on_node_add(mknode("n0", cpu=1))
+    s.on_node_add(mknode("n1", cpu=1))
+    old = bound("old", "n0", cpu="900m", priority=5)
+    old.creation_index = 1
+    young = bound("young", "n1", cpu="900m", priority=5)
+    young.creation_index = 99
+    s.on_pod_add(old)
+    s.on_pod_add(young)
+    s.on_pod_add(Pod(name="vip", priority=100,
+                     requests=Resources.make(cpu="500m", memory="128Mi")))
+    s.schedule_pending()
+    assert s.preemptor.evictor.evicted == ["default/young"]
+
+
+def test_reprieve_conservatism_vs_oracle():
+    """Quantified conservatism bound (docs/PARITY.md #4): the device reprieve
+    never evicts FEWER victims than the reference's selectVictimsOnNode
+    replay, and after evicting the device's victims the preemptor always
+    fits — conservative, never unsound."""
+    import random
+
+    from kubernetes_tpu.api import semantics as sem
+
+    def oracle_victims(pod, node, nodes, existing):
+        nodes_by_name = {n.name: n for n in nodes}
+
+        def fits(exist):
+            used = Resources(
+                milli_cpu=sum(e.requests.milli_cpu for e in exist
+                              if e.node_name == node.name),
+                memory_kib=sum(e.requests.memory_kib for e in exist
+                               if e.node_name == node.name))
+            cnt = sum(1 for e in exist if e.node_name == node.name)
+            ok_res, _ = sem.pod_fits_resources(pod, node, used, cnt)
+            return (ok_res
+                    and sem.interpod_affinity_fits(pod, node, nodes_by_name,
+                                                   exist)
+                    and sem.topology_spread_fits(pod, node, nodes, exist))
+
+        pot = [e for e in existing
+               if e.node_name == node.name and e.priority < pod.priority]
+        others = [e for e in existing if e not in pot]
+        if not fits(others):
+            return None
+        kept, victims = [], []
+        for v in sorted(pot, key=lambda e: (-e.priority, e.creation_index)):
+            if fits(others + kept + [v]):
+                kept.append(v)
+            else:
+                victims.append(v)
+        return victims
+
+    rng = random.Random(7)
+    extra_evictions = 0
+    total_evictions = 0
+    for trial in range(6):
+        s, clock = mksched()
+        n_nodes = rng.randint(1, 3)
+        nodes = [mknode(f"n{i}", cpu=2) for i in range(n_nodes)]
+        for n in nodes:
+            s.on_node_add(n)
+        existing = []
+        for i in range(rng.randint(1, 5)):
+            v = bound(f"e{i}", f"n{rng.randrange(n_nodes)}",
+                      cpu=rng.choice(["400m", "800m", "1200m"]),
+                      priority=rng.randrange(3))
+            v.labels = {"app": rng.choice(["red", "blue"])}
+            if rng.random() < 0.4:
+                v.affinity = Affinity(anti_required=(PodAffinityTerm(
+                    selector=LabelSelector.of(
+                        match_labels={"app": rng.choice(["red", "blue"])}),
+                    topology_key=HOSTNAME),))
+            v.creation_index = i
+            existing.append(v)
+            s.on_pod_add(v)
+        vip = Pod(name="vip", priority=100, labels={"app": "red"},
+                  requests=Resources.make(cpu="1500m", memory="128Mi"))
+        s.on_pod_add(vip)
+        s.schedule_pending()
+        evicted = set(s.preemptor.evictor.evicted)
+        if not evicted:
+            continue
+        node_name = s.queue.nominated_node("default/vip")
+        node = next(n for n in nodes if n.name == node_name)
+        want = oracle_victims(vip, node, nodes, existing)
+        assert want is not None, "device chose a non-candidate node"
+        want_keys = {v.key for v in want}
+        assert want_keys <= evicted, (
+            f"device under-evicted: oracle wants {want_keys}, got {evicted}")
+        # soundness: the preemptor fits with the device's victims gone
+        survivors = [e for e in existing if e.key not in evicted]
+        by_name = {n.name: n for n in nodes}
+        used = Resources(
+            milli_cpu=sum(e.requests.milli_cpu for e in survivors
+                          if e.node_name == node.name),
+            memory_kib=sum(e.requests.memory_kib for e in survivors
+                           if e.node_name == node.name))
+        cntp = sum(1 for e in survivors if e.node_name == node.name)
+        ok_res, _ = sem.pod_fits_resources(vip, node, used, cntp)
+        assert ok_res
+        assert sem.interpod_affinity_fits(vip, node, by_name, survivors)
+        extra_evictions += len(evicted) - len(want_keys)
+        total_evictions += len(evicted)
+    # the conservatism is bounded: documented over-eviction only, and the
+    # scan evicted SOMETHING across the trials
+    assert total_evictions > 0
+    assert extra_evictions <= total_evictions

@@ -1,0 +1,180 @@
+"""Golden SCORE tests: the device score matrix vs the pure-Python oracle.
+
+The reference unit-tests each priority function with fixed tables
+(algorithm/priorities/*_test.go); here the full composed score surface —
+preferred node affinity, taints, least/balanced allocation, preferred
+inter-pod affinity INCLUDING the symmetric existing-pod pass, EvenPodsSpread
+ScheduleAnyway score, SelectorSpread (host+zone), ImageLocality — is compared
+against api/semantics.py on randomized clusters, feasible entries only.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import semantics as sem
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    Resources,
+    Taint,
+    TaintEffect,
+    TopologySpreadConstraint,
+    UnsatisfiableAction,
+    WeightedPodAffinityTerm,
+)
+from kubernetes_tpu.sched.cycle import UNSCHEDULABLE_TAINT_KEY, _scores
+from kubernetes_tpu.state.dims import Dims
+from kubernetes_tpu.state.encode import Encoder
+
+ZONE = "topology.kubernetes.io/zone"
+HOSTNAME = "kubernetes.io/hostname"
+APPS = ["web", "db", "cache", "queue"]
+IMAGES = [("registry/app:v1", 50 * 1024), ("registry/db:v2", 400 * 1024),
+          ("registry/tiny:v1", 8 * 1024), ("registry/big:v3", 900 * 1024)]
+
+
+def rand_node(rng, i):
+    labels = {HOSTNAME: f"n{i}"}
+    if rng.random() < 0.8:
+        labels[ZONE] = f"z{rng.randrange(3)}"
+    images = {}
+    for name, size in IMAGES:
+        if rng.random() < 0.5:
+            images[name] = size
+    taints = ()
+    if rng.random() < 0.3:
+        taints = (Taint("dedicated", "x", TaintEffect.PREFER_NO_SCHEDULE),)
+    return Node(name=f"n{i}", labels=labels,
+                allocatable=Resources.make(cpu=rng.choice(["2", "4"]),
+                                           memory="8Gi", pods=50),
+                taints=taints, images_kib=images)
+
+
+def rand_pod(rng, i, bound_to=None):
+    app = rng.choice(APPS)
+    sel = LabelSelector.of(match_labels={"app": rng.choice(APPS)})
+    paff = panti = ()
+    if rng.random() < 0.5:
+        paff = (WeightedPodAffinityTerm(
+            term=PodAffinityTerm(selector=sel, topology_key=ZONE),
+            weight=rng.randrange(1, 100)),)
+    if rng.random() < 0.4:
+        panti = (WeightedPodAffinityTerm(
+            term=PodAffinityTerm(
+                selector=LabelSelector.of(match_labels={"app": rng.choice(APPS)}),
+                topology_key=rng.choice([ZONE, HOSTNAME])),
+            weight=rng.randrange(1, 100)),)
+    aff_req = ()
+    if bound_to and rng.random() < 0.3:
+        aff_req = (PodAffinityTerm(
+            selector=LabelSelector.of(match_labels={"app": rng.choice(APPS)}),
+            topology_key=ZONE),)
+    spread = ()
+    if rng.random() < 0.5:
+        spread = (TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE,
+            when_unsatisfiable=UnsatisfiableAction.SCHEDULE_ANYWAY,
+            selector=LabelSelector.of(match_labels={"app": app})),)
+    ssel = ()
+    if rng.random() < 0.5:
+        ssel = (LabelSelector.of(match_labels={"app": app}),)
+    images = tuple(nm for nm, _ in IMAGES if rng.random() < 0.5)
+    return Pod(
+        name=f"p{i}", labels={"app": app},
+        requests=Resources.make(cpu=rng.choice(["100m", "500m"]),
+                                memory=rng.choice(["128Mi", "1Gi"])),
+        affinity=Affinity(pod_required=aff_req, pod_preferred=paff,
+                          anti_preferred=panti),
+        topology_spread=spread,
+        spread_selectors=ssel,
+        images=images,
+        node_name=bound_to or "",
+        creation_index=i,
+    )
+
+
+def oracle_score(pod, node, nodes, existing, used_by_node):
+    """Float composition mirroring the engine's score row exactly."""
+    used, used_pods = used_by_node[node.name]
+
+    def least(reqv, usedv, capv):
+        total = usedv + reqv
+        if capv == 0 or total > capv:
+            return 0.0
+        return (capv - total) * 100.0 / capv
+
+    least_s = (least(pod.requests.milli_cpu, used.milli_cpu,
+                     node.allocatable.milli_cpu)
+               + least(pod.requests.memory_kib, used.memory_kib,
+                       node.allocatable.memory_kib)) / 2.0
+
+    def frac(total, cap):
+        return total / cap if cap else 1.0
+
+    cf = frac(used.milli_cpu + pod.requests.milli_cpu,
+              node.allocatable.milli_cpu)
+    mf = frac(used.memory_kib + pod.requests.memory_kib,
+              node.allocatable.memory_kib)
+    balanced = 0.0 if (cf >= 1 or mf >= 1) else 100.0 - abs(cf - mf) * 100.0
+
+    # preferred node affinity: none in this workload → contributes 0
+    # taint PreferNoSchedule: reversed max-normalized over nodes
+    counts = {n.name: sem.taint_toleration_score(pod, n) for n in nodes}
+    mx = max(counts.values())
+    taint_s = 100.0 * (1.0 - counts[node.name] / mx) if mx > 0 else 100.0
+
+    soft_ip = sem.interpod_preferred_scores(pod, nodes, existing)[node.name]
+    even_soft = sem.even_spread_soft_scores(pod, nodes, existing)[node.name]
+    ssel = sem.selector_spread_scores(pod, nodes, existing)[node.name]
+    img = sem.image_locality_scores(pod, nodes)[node.name]
+    return least_s + balanced + taint_s + soft_ip + even_soft + ssel + img
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_score_matrix_matches_oracle(seed):
+    rng = random.Random(1000 + seed)
+    n_nodes = rng.randint(3, 6)
+    nodes = [rand_node(rng, i) for i in range(n_nodes)]
+    existing = [rand_pod(rng, 100 + i, bound_to=rng.choice(nodes).name)
+                for i in range(rng.randint(0, 8))]
+    pending = [rand_pod(rng, i) for i in range(rng.randint(1, 6))]
+
+    base = Dims(N=8, P=8, E=16, R=8, SC=64, S=64, SR=64, SL=64, SN=32, D=8,
+                PAT=2, PAN=2, TS=2, SS=2, CI=4, IMG=8, K=4)
+    enc = Encoder()
+    enc.vocabs.label_keys.intern(UNSCHEDULABLE_TAINT_KEY)
+    enc.vocabs.label_vals.intern("")
+    tables, ex, pe, d = enc.encode_cluster(nodes, existing, pending, base)
+    uk = jnp.int32(enc.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+    ev = jnp.int32(enc.vocabs.label_vals.get(""))
+    got = np.asarray(_scores(jax.device_put(tables), jax.device_put(pe),
+                             (uk, ev), d.D, jax.device_put(ex)))
+
+    used_by_node = {}
+    for n in nodes:
+        agg = Resources()
+        cnt = 0
+        cpu = mem = 0
+        for exp in existing:
+            if exp.node_name == n.name:
+                cpu += exp.requests.milli_cpu
+                mem += exp.requests.memory_kib
+                cnt += 1
+        used_by_node[n.name] = (Resources(milli_cpu=cpu, memory_kib=mem), cnt)
+
+    for pi, pod in enumerate(pending):
+        for ni, node in enumerate(nodes):
+            if got[pi, ni] == -np.inf:
+                continue  # infeasible — covered by the filter golden tests
+            want = oracle_score(pod, node, nodes, existing, used_by_node)
+            assert abs(got[pi, ni] - want) < 0.05, (
+                f"seed={seed} pod={pod.name} node={node.name}: "
+                f"device={got[pi, ni]:.4f} oracle={want:.4f}\n"
+                f"pod={pod}")

@@ -209,13 +209,30 @@ class TestStorage:
         w.stop()
 
     def test_watch_gone_after_compaction(self, storage):
+        from kubernetes_tpu.storage.cacher import WatchCache
+
         storage.create("/registry/pods/default/a", _pod("a"), "pods")
         storage.create("/registry/pods/default/b", _pod("b"), "pods")
+        # let the pump ingest both events into the watch cache first, so the
+        # compaction below cannot race it into the all-watchers-gone path
+        deadline = time.time() + 2
+        while storage._dispatched_rev < storage.kv.rev() \
+                and time.time() < deadline:
+            time.sleep(0.01)
         storage.kv.compact(storage.kv.rev())
         # since_rv == compaction point is still legal (needs only events > rv)
         w = storage.watch("/registry/pods/", since_rv=str(storage.kv.rev()))
         w.stop()
-        # since_rv older than the compaction point must 410
+        # a resume WITHIN the watch-cache window is served from memory even
+        # though the KV store compacted it away (cacher.go:369-374) — the
+        # Cacher tier exists precisely to decouple watchers from compaction
+        w2 = storage.watch("/registry/pods/", since_rv="1")
+        ev = w2.next(timeout=2)
+        assert ev is not None and ev.object["metadata"]["name"] == "b"
+        w2.stop()
+        # a resume below the CACHE horizon falls through to storage, which
+        # compacted → 410 (the reflector relists)
+        storage.watch_cache = WatchCache(horizon=storage.kv.rev())
         with pytest.raises(errors.StatusError) as ei:
             storage.watch("/registry/pods/", since_rv="1")
         assert errors.is_gone(ei.value)
@@ -236,3 +253,65 @@ class TestStorage:
         end = w.next(timeout=3)
         assert end is not None and end.type == mwatch.ERROR
         assert w.next(timeout=0.5) is None  # stopped
+
+
+class TestWatchCache:
+    """Cacher tier (storage/cacher.py ⇔ cacher.go:309): N watchers must not
+    multiply storage reads, and events are decoded once."""
+
+    def test_catchup_reads_independent_of_watcher_count(self):
+        from kubernetes_tpu.storage.store import Storage
+
+        storage = Storage()
+        try:
+            for i in range(10):
+                storage.create(f"/registry/pods/default/p{i}", _pod(f"p{i}"),
+                               "pods")
+            # let the pump populate the ring
+            deadline = time.time() + 2
+            while storage._dispatched_rev < storage.kv.rev() \
+                    and time.time() < deadline:
+                time.sleep(0.01)
+
+            reads = []
+            orig = storage.kv.events_since
+
+            def counting(rev, prefix):
+                reads.append(rev)
+                return orig(rev, prefix)
+
+            storage.kv.events_since = counting
+            watchers = [storage.watch("/registry/pods/", since_rv="1")
+                        for _ in range(32)]
+            # every catch-up (revs 2..10, 9 events each) came from the ring:
+            # the backing store saw ZERO reads for 32 watchers
+            assert reads == [], f"storage reads on cached catch-up: {reads}"
+            assert storage.watch_cache.hits >= 32
+            for w in watchers:
+                for _ in range(9):
+                    ev = w.next(timeout=2)
+                    assert ev is not None and ev.type == mwatch.ADDED
+                w.stop()
+        finally:
+            storage.close()
+
+    def test_prehorizon_resume_falls_back_once(self):
+        from kubernetes_tpu.storage.cacher import WatchCache
+        from kubernetes_tpu.storage.store import Storage
+
+        storage = Storage()
+        try:
+            for i in range(4):
+                storage.create(f"/registry/pods/default/p{i}", _pod(f"p{i}"),
+                               "pods")
+            # shrink the window so rev 1 predates the horizon
+            storage.watch_cache = WatchCache(horizon=storage.kv.rev())
+            before = storage.watch_cache.storage_fallbacks
+            w = storage.watch("/registry/pods/", since_rv="1")
+            assert storage.watch_cache.storage_fallbacks == before + 1
+            for _ in range(3):  # revs 2..4
+                ev = w.next(timeout=2)
+                assert ev is not None and ev.type == mwatch.ADDED
+            w.stop()
+        finally:
+            storage.close()
