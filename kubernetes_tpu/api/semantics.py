@@ -398,6 +398,37 @@ def node_affinity_score(pod: Pod, node: Node) -> int:
     return total
 
 
+def no_disk_conflict(pod: Pod, node_pods: Sequence[Pod]) -> bool:
+    """NoDiskConflict (predicates.go:156-221): same (driver, volume) on one
+    node conflicts unless both mounts are read-only."""
+    for v in pod.volumes:
+        for ex in node_pods:
+            for ev in ex.volumes:
+                if v.driver == ev.driver and v.vol_id == ev.vol_id \
+                        and not (v.read_only and ev.read_only):
+                    return False
+    return True
+
+
+def max_volume_count_fits(pod: Pod, node: Node,
+                          node_pods: Sequence[Pod]) -> bool:
+    """Max attachable volumes per driver (csi_volume_predicate.go:89-160):
+    distinct volumes already attached plus the pod's new distinct volumes
+    must stay within Node.volume_limits[driver] (absent = unlimited)."""
+    if not pod.volumes or not node.volume_limits:
+        return True
+    attached: Dict[str, set] = {}
+    for ex in node_pods:
+        for ev in ex.volumes:
+            attached.setdefault(ev.driver, set()).add(ev.vol_id)
+    for v in pod.volumes:
+        attached.setdefault(v.driver, set()).add(v.vol_id)
+    for drv, lim in node.volume_limits.items():
+        if lim >= 0 and len(attached.get(drv, ())) > lim:
+            return False
+    return True
+
+
 # --------------------------------------------------------------------------- #
 # Score parity set (priorities/) — pure-Python references for the tensor
 # kernels in ops/scores.py; golden-tested in tests/test_scores.py

@@ -294,6 +294,19 @@ class TopologySpreadConstraint:
 
 
 @dataclass(frozen=True)
+class VolumeRef:
+    """An attachable volume a pod mounts (the GCE-PD/EBS/RBD/ISCSI/CSI
+    subset NoDiskConflict and the max-volume-count predicates care about:
+    predicates.go:156-221, csi_volume_predicate.go:89). `driver` scopes both
+    the conflict check and the per-node attach limit; EBS-style volumes that
+    conflict even read-only are modeled with read_only=False."""
+
+    vol_id: str
+    driver: str = "pd"
+    read_only: bool = False
+
+
+@dataclass(frozen=True)
 class HostPort:
     """A (protocol, hostIP, hostPort) triple; conflict semantics per
     nodeinfo/node_info.go HostPortInfo (wildcard 0.0.0.0 conflicts with all IPs)."""
@@ -323,6 +336,7 @@ class Pod:
     tolerations: Tuple[Toleration, ...] = ()
     topology_spread: Tuple[TopologySpreadConstraint, ...] = ()
     host_ports: Tuple[HostPort, ...] = ()
+    volumes: Tuple[VolumeRef, ...] = ()  # attachable volumes (NoDiskConflict)
     # container image names (ImageLocality; spec.containers[*].image)
     images: Tuple[str, ...] = ()
     # selectors of the Services/RCs/RSs/StatefulSets owning this pod —
@@ -351,6 +365,9 @@ class Node:
     taints: Tuple[Taint, ...] = ()
     unschedulable: bool = False  # spec.unschedulable (CheckNodeUnschedulable)
     images_kib: Dict[str, int] = field(default_factory=dict)  # image name -> size
+    # per-driver attachable-volume limits (CSINode allocatable / cloud caps,
+    # csi_volume_predicate.go getMaxVolumeFunc); absent driver = unlimited
+    volume_limits: Dict[str, int] = field(default_factory=dict)
 
 
 WELL_KNOWN_ZONE_LABEL = "topology.kubernetes.io/zone"

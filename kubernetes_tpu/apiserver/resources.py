@@ -252,6 +252,15 @@ def build_scheme() -> Scheme:
     # ---- coordination (leader-election leases) ----
     s.register(R("coordination.k8s.io", "v1", "Lease", "leases"))
 
+    # --- aggregation (kube-aggregator APIService registry) ---
+    s.register(R("apiregistration.k8s.io", "v1", "APIService", "apiservices",
+                 namespaced=False, subresources=("status",)))
+
+    # --- autoscaling ---
+    s.register(R("autoscaling", "v1", "HorizontalPodAutoscaler",
+                 "horizontalpodautoscalers", short_names=("hpa",),
+                 subresources=("status",)))
+
     # ---- storage ----
     s.register(R("storage.k8s.io", "v1", "StorageClass", "storageclasses",
                  namespaced=False, short_names=("sc",)))

@@ -317,7 +317,18 @@ def handle_rest(api: APIServer, method: str, path: str,
     name = rest[1] if len(rest) > 1 else ""
     sub = rest[2] if len(rest) > 2 else ""
 
-    st = api.store(group, resource)
+    try:
+        st = api.store(group, resource)
+    except errors.StatusError:
+        # aggregation layer (kube-aggregator proxyHandler): a group/version
+        # no local registry serves may be claimed by an APIService
+        from kubernetes_tpu.apiserver import aggregator
+
+        version = parts[2] if parts[0] == "apis" and len(parts) > 2 else "v1"
+        svc = aggregator.find_apiservice(api, group, version)
+        if svc is None:
+            raise
+        return aggregator.proxy(api, svc, method, path, query, body)
     info = st.info
 
     lsel = query.get("labelSelector", "")

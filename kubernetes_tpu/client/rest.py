@@ -256,6 +256,8 @@ _KNOWN = {
     "cronjobs": ("batch", "v1beta1", "cronjobs", True),
     "poddisruptionbudgets": ("policy", "v1beta1", "poddisruptionbudgets", True),
     "leases": ("coordination.k8s.io", "v1", "leases", True),
+    "horizontalpodautoscalers": ("autoscaling", "v1",
+                                 "horizontalpodautoscalers", True),
     "storageclasses": ("storage.k8s.io", "v1", "storageclasses", False),
     "csinodes": ("storage.k8s.io", "v1", "csinodes", False),
     "priorityclasses": ("scheduling.k8s.io", "v1", "priorityclasses", False),

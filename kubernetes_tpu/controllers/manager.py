@@ -27,6 +27,12 @@ from kubernetes_tpu.controllers.infra import (
     PodGCController,
     ResourceQuotaController,
 )
+from kubernetes_tpu.controllers.autoscale import (
+    AttachDetachController,
+    HorizontalPodAutoscalerController,
+    NodeIpamController,
+    VolumeExpansionController,
+)
 from kubernetes_tpu.controllers.workloads import (
     CronJobController,
     DaemonSetController,
@@ -52,6 +58,10 @@ DEFAULT_CONTROLLERS: Dict[str, Callable] = {
     "podgc": PodGCController,
     "disruption": DisruptionController,
     "resourcequota": ResourceQuotaController,
+    "horizontalpodautoscaler": HorizontalPodAutoscalerController,
+    "attachdetach": AttachDetachController,
+    "volumeexpand": VolumeExpansionController,
+    "nodeipam": NodeIpamController,
 }
 
 

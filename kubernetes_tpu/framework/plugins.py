@@ -98,6 +98,22 @@ class NodeUnschedulable(FilterPlugin):
         return ctx.components.taints
 
 
+class VolumeRestrictions(FilterPlugin):
+    """volumerestrictions/ — NoDiskConflict (predicates.go:156-221)."""
+
+    def filter_mask(self, state: CycleState, ctx: TensorContext):
+        return ctx.components.volumes
+
+
+class NodeVolumeLimits(FilterPlugin):
+    """nodevolumelimits/ — the max-volume-count family
+    (csi_volume_predicate.go:89; shares the fused volumes component with
+    VolumeRestrictions — both are exact subsets of it)."""
+
+    def filter_mask(self, state: CycleState, ctx: TensorContext):
+        return ctx.components.volumes
+
+
 class InterPodAffinity(FilterPlugin, ScorePlugin):
     """interpodaffinity/ — MatchInterPodAffinity (predicates.go:1212) filter +
     soft (anti)affinity score (interpod_affinity.go:119-215)."""
@@ -287,23 +303,12 @@ class NodeResourcesBalancedAllocation(_ResourceScoreBase):
     _index = 1
 
 
-class NodeResourcesMostAllocated(ScorePlugin):
-    """noderesources/most_allocated.go — bin-packing: (total/cap)×100 averaged
-    over cpu+memory (most_requested.go:60 semantics)."""
+class NodeResourcesMostAllocated(_ResourceScoreBase):
+    """noderesources/most_allocated.go — bin packing: (total/cap)×100 averaged
+    over cpu+memory (most_requested.go:52-70); shares resource_scores_row with
+    least/balanced so the formula lives once."""
 
-    def score_matrix(self, state: CycleState, ctx: TensorContext):
-        tables = ctx.tables
-
-        def row(c):
-            req_vec = tables.reqs.vec[tables.classes.rid[c]]
-            total = tables.nodes.used + req_vec[None, :]
-            cap = tables.nodes.alloc
-            def frac(t, cp):
-                f = t.astype(jnp.float32) / jnp.maximum(cp.astype(jnp.float32), 1.0)
-                return jnp.where((cp > 0) & (t <= cp), f * 100.0, 0.0)
-            return (frac(total[:, 0], cap[:, 0]) + frac(total[:, 1], cap[:, 1])) / 2.0
-
-        return jax.vmap(row)(ctx.pending.cls)
+    _index = 2
 
 
 class NodePreferAvoidPods(ScorePlugin):
@@ -388,6 +393,8 @@ def default_registry() -> Registry:
         "NodeResourcesMostAllocated": lambda cfg: NodeResourcesMostAllocated(),
         "NodePreferAvoidPods": lambda cfg: NodePreferAvoidPods(),
         "NodeAffinityScore": lambda cfg: NodeAffinityScore(),
+        "VolumeRestrictions": lambda cfg: VolumeRestrictions(),
+        "NodeVolumeLimits": lambda cfg: NodeVolumeLimits(),
         "SelectorSpread": lambda cfg: SelectorSpread(),
         "DefaultPodTopologySpread": lambda cfg: SelectorSpread(),
         "ImageLocality": lambda cfg: ImageLocality(),
@@ -405,7 +412,7 @@ def default_plugins() -> Plugins:
         filter=PluginSet(enabled=[
             "NodeUnschedulable", "NodeName", "NodePorts", "NodeAffinity",
             "NodeResourcesFit", "TaintToleration", "InterPodAffinity",
-            "PodTopologySpread",
+            "PodTopologySpread", "VolumeRestrictions", "NodeVolumeLimits",
         ]),
         score=PluginSet(enabled=[
             "NodeResourcesLeastAllocated", "NodeResourcesBalancedAllocation",

@@ -78,6 +78,12 @@ def new_too_many_requests(message: str, retry_seconds: int = 1) -> StatusError:
                        {"retryAfterSeconds": retry_seconds})
 
 
+def new_service_unavailable(message: str) -> StatusError:
+    """503 — aggregated APIService backend unreachable
+    (kube-aggregator proxyHandler error path)."""
+    return StatusError(503, "ServiceUnavailable", message)
+
+
 def new_gone(message: str) -> StatusError:
     """410 Gone — watch/list from a compacted resourceVersion
     (storage.NewTooLargeResourceVersionError / etcd compaction)."""

@@ -34,6 +34,7 @@ from .lattice import CycleArrays
 from .ports import port_conflict_row
 from .scores import even_spread_soft_row, selector_spread_row
 from .topospread import spread_row
+from .volumes import volume_components_row, volume_ok_row
 
 
 class AssignState(NamedTuple):
@@ -44,6 +45,8 @@ class AssignState(NamedTuple):
     CNT: Array   # [S, N] i32 — per-node term match counts
     HOLD: Array  # [S, N] i32 — per-node anti-term holders
     WSYM: Array  # [S, N] f32 — signed symmetric soft-affinity weights
+    vol_any: Array  # [N, VW] u32 — attached volumes (NoDiskConflict/limits)
+    vol_rw: Array   # [N, VW] u32 — attached read-write
 
 
 class AssignResult(NamedTuple):
@@ -109,7 +112,15 @@ def assign_batch(
         WSYM = state.WSYM.at[:, choice].add(
             jnp.where(feasible, cyc.WCOLS[:, c], 0.0))
 
-        return AssignState(used, ppa, ppw, ppt, CNT, HOLD, WSYM), (node, feasible)
+        vs = tables.classes.volset[c]
+        live_vs = feasible & (vs >= 0)
+        va = jnp.where(live_vs, tables.volsets.any_words[jnp.maximum(vs, 0)], 0)
+        vr = jnp.where(live_vs, tables.volsets.rw_words[jnp.maximum(vs, 0)], 0)
+        vol_any = state.vol_any.at[choice].set(state.vol_any[choice] | va)
+        vol_rw = state.vol_rw.at[choice].set(state.vol_rw[choice] | vr)
+
+        return AssignState(used, ppa, ppw, ppt, CNT, HOLD, WSYM,
+                           vol_any, vol_rw), (node, feasible)
 
     final, (nodes_sorted, feas_sorted) = jax.lax.scan(step, init, order)
 
@@ -160,9 +171,13 @@ def pod_mask_row(
     ) | ~_on(ecfg.f_spread)
     host_ok = (node_name_req < 0) | (nodes.name_id == node_name_req) \
         | ~_on(ecfg.f_name)
+    vconf_free, vlimit_ok = volume_components_row(
+        tables, state.vol_any, state.vol_rw, cls)
+    vol_ok = (vconf_free | ~_on(ecfg.f_volrestrict)) \
+        & (vlimit_ok | ~_on(ecfg.f_vollimits))
     return (
         cyc.static.mask[cls]
-        & fit & port_ok & interpod_ok & spread_ok & host_ok & valid
+        & fit & port_ok & interpod_ok & spread_ok & host_ok & vol_ok & valid
     )
 
 
@@ -219,6 +234,7 @@ class MaskComponents(NamedTuple):
     anti: Array         # MatchInterPodAffinity (anti-affinity half)
     spread: Array       # EvenPodsSpread
     host: Array         # PodFitsHost (spec.nodeName)
+    volumes: Array      # NoDiskConflict + max-volume-count family
 
 
 def mask_components(
@@ -249,11 +265,13 @@ def mask_components(
             cyc.static.node_match[c], nodes, D,
         )
         host_ok = (nnr < 0) | (nodes.name_id == nnr)
+        vol_ok = volume_ok_row(tables, state.vol_any, state.vol_rw, c)
         nm = cyc.static.node_match[c]
         # static.mask = node_match ∧ taint_ok ∧ unsched_pass ∧ class valid;
         # recover the taint/unschedulable part by division
         taints_ok = cyc.static.mask[c] | ~nm
-        return nm & v, taints_ok, fit, port_ok, aff_ok, anti_ok, spread_ok, host_ok
+        return (nm & v, taints_ok, fit, port_ok, aff_ok, anti_ok, spread_ok,
+                host_ok, vol_ok)
 
     parts = jax.vmap(row)(pods.cls, pods.node_name_req, pods.valid)
     return MaskComponents(*parts)
@@ -283,4 +301,5 @@ def initial_state(tables: ClusterTables, cyc: CycleArrays) -> AssignState:
     return AssignState(
         used=n.used, ppa=n.port_pair_any, ppw=n.port_pair_wild, ppt=n.port_triple,
         CNT=cyc.CNT, HOLD=cyc.HOLD, WSYM=cyc.WSYM,
+        vol_any=n.vol_any, vol_rw=n.vol_rw,
     )

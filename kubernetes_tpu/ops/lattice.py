@@ -47,6 +47,8 @@ class EngineConfig(NamedTuple):
     f_taints: Array         # TaintToleration
     f_interpod: Array       # InterPodAffinity (required + symmetry)
     f_spread: Array         # PodTopologySpread (DoNotSchedule)
+    f_volrestrict: Array    # VolumeRestrictions (NoDiskConflict)
+    f_vollimits: Array      # NodeVolumeLimits (max attach counts)
     w_node_affinity: Array  # NodeAffinityScore (preferred terms)
     w_taint: Array          # TaintToleration score
     w_img: Array            # ImageLocality
@@ -65,6 +67,7 @@ def default_engine_config() -> EngineConfig:
     return EngineConfig(
         f_unsched=one, f_name=one, f_ports=one, f_node_affinity=one,
         f_fit=one, f_taints=one, f_interpod=one, f_spread=one,
+        f_volrestrict=one, f_vollimits=one,
         w_node_affinity=one, w_taint=one, w_img=one, w_least=one,
         w_balanced=one, w_most=zero, w_interpod=one, w_even=one, w_ssel=one,
     )

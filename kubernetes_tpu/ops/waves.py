@@ -335,11 +335,41 @@ def assign_waves(
         ).any(-1)
         keep = keep & (~has_p[:, None] | ~conflict)
 
-        # committed port words (kept classes only)
+        # volume conflict/limits against same-wave earlier classes on the
+        # same node (the per-node cumulative pass, like ports): exclusive-
+        # prefix OR of volume words, then re-check conflict + attach limits
+        vs_ord = classes.volset[cord]
+        vsafe = jnp.maximum(vs_ord, 0)
+        has_v = (vs_ord >= 0)
+        vanyw = tables.volsets.any_words[vsafe]               # [SC, VW]
+        vrww = tables.volsets.rw_words[vsafe]
+        kv = (keep & has_v[:, None])[:, :, None]
+        scan_orv = lambda W: lax.associative_scan(
+            jnp.bitwise_or, jnp.where(kv, W[:, None, :], 0), axis=0)
+        exc_va, exc_vr = (shift(scan_orv(vanyw)), shift(scan_orv(vrww)))
+        tot_any = state.vol_any[None] | exc_va                # [SC, N, VW]
+        tot_rw = state.vol_rw[None] | exc_vr
+        vconf = (
+            ((vanyw[:, None, :] & tot_rw) != 0)
+            | ((vrww[:, None, :] & tot_any) != 0)
+        ).any(-1)
+        after_v = tot_any | vanyw[:, None, :]
+        vcnt = jax.lax.population_count(
+            after_v[:, :, None, :] & tables.drv_masks[None, None, :, :]
+        ).sum(-1).astype(jnp.int32)                           # [SC, N, DR]
+        vlim = nodes.vol_limit[None]                          # [1, N, DR]
+        vlim_ok = ((vlim < 0) | (vcnt <= vlim)).all(-1)
+        keep = keep & (~has_v[:, None] | (~vconf & vlim_ok))
+
+        # committed port + volume words (kept classes only)
         kp2 = (keep & has_p[:, None])[:, :, None]
         or_last = lambda W: lax.associative_scan(
             jnp.bitwise_or, jnp.where(kp2, W[:, None, :], 0), axis=0)[-1]
         orp, orw, ort = or_last(pairw), or_last(wildw), or_last(tripw)
+        kv2 = (keep & has_v[:, None])[:, :, None]
+        or_lastv = lambda W: lax.associative_scan(
+            jnp.bitwise_or, jnp.where(kv2, W[:, None, :], 0), axis=0)[-1]
+        orva, orvr = or_lastv(vanyw), or_lastv(vrww)
 
         A_final = jnp.zeros_like(A).at[cord].set(keep)
         m = A_final.sum(axis=1).astype(jnp.int32)             # [SC]
@@ -355,6 +385,7 @@ def assign_waves(
             used=used2,
             ppa=state.ppa | orp, ppw=state.ppw | orw, ppt=state.ppt | ort,
             CNT=CNT2, HOLD=HOLD2, WSYM=WSYM2,
+            vol_any=state.vol_any | orva, vol_rw=state.vol_rw | orvr,
         )
 
         # ---- map admissions back to pods (rank among kept, score order) ----

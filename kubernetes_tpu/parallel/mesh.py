@@ -64,6 +64,8 @@ def _node_sharded_tables_spec(tables: ClusterTables) -> ClusterTables:
         classes=rep(tables.classes),
         images=rep(tables.images),
         zone_keys=P(),
+        volsets=rep(tables.volsets),
+        drv_masks=P(),
     )
 
 

@@ -44,6 +44,12 @@ PREDICATE_TO_PLUGIN = {
     "CheckNodeUnschedulable": "NodeUnschedulable",
     "MatchInterPodAffinity": "InterPodAffinity",
     "EvenPodsSpread": "PodTopologySpread",
+    "NoDiskConflict": "VolumeRestrictions",
+    "MaxCSIVolumeCountPred": "NodeVolumeLimits",
+    "MaxEBSVolumeCount": "NodeVolumeLimits",
+    "MaxGCEPDVolumeCount": "NodeVolumeLimits",
+    "MaxAzureDiskVolumeCount": "NodeVolumeLimits",
+    "MaxCinderVolumeCount": "NodeVolumeLimits",
 }
 
 # Legacy priority name → framework score plugin.
@@ -131,6 +137,8 @@ class KubeSchedulerConfiguration:
             f_taints=1.0 if "TaintToleration" in fset else 0.0,
             f_interpod=1.0 if "InterPodAffinity" in fset else 0.0,
             f_spread=1.0 if "PodTopologySpread" in fset else 0.0,
+            f_volrestrict=1.0 if "VolumeRestrictions" in fset else 0.0,
+            f_vollimits=1.0 if "NodeVolumeLimits" in fset else 0.0,
             w_node_affinity=w("NodeAffinityScore"),
             w_taint=w("TaintToleration"),
             w_img=w("ImageLocality"),

@@ -1,0 +1,125 @@
+"""Cache debugger: comparer + dumper, plus device-mirror drift detection.
+
+Analog of /root/reference/pkg/scheduler/internal/cache/debugger/
+(debugger.go:55-68: SIGUSR2 → CompareNodes/ComparePods + Dump). The batched
+design adds a third check the reference doesn't need: `verify_staging`
+re-encodes every node/pod row from scratch and diffs it against the
+incrementally-patched host staging arrays — the guard against silent drift
+in the device mirror that per-pod caches are less exposed to (the
+cache-corruption Fatalf analog, cache.go:445,473)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..state.cache import SchedulerCache
+from ..state.encode import Encoder
+
+
+class CacheComparer:
+    """debugger/comparer.go: cache contents vs the apiserver's view."""
+
+    def __init__(self, cache: SchedulerCache, client=None):
+        self.cache = cache
+        self.client = client
+
+    def compare_nodes(self) -> Tuple[List[str], List[str]]:
+        """(missing_from_cache, stale_in_cache) node names."""
+        if self.client is None:
+            return [], []
+        from ..machinery import meta
+
+        api_names = {meta.name(n)
+                     for n in self.client.nodes.list()["items"]}
+        cache_names = {n.name for n in self.cache.nodes()}
+        return sorted(api_names - cache_names), sorted(cache_names - api_names)
+
+    def compare_pods(self) -> Tuple[List[str], List[str]]:
+        """(missing_from_cache, stale_in_cache) pod keys; assumed pods are
+        legitimately cache-only and excluded from staleness (comparer.go
+        ComparePods ignores assumed)."""
+        if self.client is None:
+            return [], []
+        from ..machinery import meta
+
+        api_keys = {f"{meta.namespace(p)}/{meta.name(p)}"
+                    for p in self.client.pods.list(None)["items"]
+                    if p.get("spec", {}).get("nodeName")}
+        cache_keys = {p.key for p in self.cache.scheduled_pods()}
+        assumed = {p.key for p in self.cache.scheduled_pods()
+                   if self.cache.is_assumed(p.key)}
+        return (sorted(api_keys - cache_keys),
+                sorted(cache_keys - api_keys - assumed))
+
+    def dump(self) -> str:
+        """debugger/dumper.go: human-readable cache dump."""
+        lines = [f"generation={self.cache.generation}"]
+        by_node: Dict[str, List[str]] = {}
+        for p in self.cache.scheduled_pods():
+            mark = "*" if self.cache.is_assumed(p.key) else ""
+            by_node.setdefault(p.node_name, []).append(p.key + mark)
+        for n in self.cache.nodes():
+            pods = ", ".join(sorted(by_node.get(n.name, []))) or "-"
+            lines.append(f"node {n.name}: {pods}")
+        orphans = by_node.keys() - {n.name for n in self.cache.nodes()}
+        for nn in sorted(orphans):
+            lines.append(f"node {nn} (GONE): {', '.join(by_node[nn])}")
+        return "\n".join(lines)
+
+    def verify_staging(self) -> List[str]:
+        """Re-encode every live node row with a scratch staging buffer and
+        diff against the incrementally-patched arrays. Any mismatch means the
+        dirty-tracking patch path diverged from a from-scratch encode — the
+        failure the reference guards with Fatalf on cache corruption."""
+        cache = self.cache
+        with cache._mu:
+            enc: Encoder = cache._encoder
+            staging = cache._staging_nodes
+            if enc is None or staging is None or cache._snapshot is None:
+                return []
+            d = cache._snapshot.dims
+            fresh = enc.empty_node_arrays(d)
+            drift: List[str] = []
+            for name, slot in cache._node_slot.items():
+                node = cache._nodes.get(name)
+                if node is None:
+                    continue
+                enc.encode_node_row(
+                    fresh, slot, node,
+                    list(cache._by_node.get(name, {}).values()), d)
+                for fld in staging._fields:
+                    a = getattr(staging, fld)[slot]
+                    b = getattr(fresh, fld)[slot]
+                    if not np.array_equal(a, b):
+                        drift.append(f"node {name} field {fld}")
+            return drift
+
+
+def install_sigusr2(comparer: CacheComparer, log=print) -> bool:
+    """debugger.go:55-68: dump + compare on SIGUSR2 (main thread only)."""
+    import signal
+    import threading
+
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def handler(signum, frame):
+        miss_n, stale_n = comparer.compare_nodes()
+        miss_p, stale_p = comparer.compare_pods()
+        drift = comparer.verify_staging()
+        log("=== scheduler cache dump (SIGUSR2) ===")
+        log(comparer.dump())
+        if miss_n or stale_n:
+            log(f"node diff: missing={miss_n} stale={stale_n}")
+        if miss_p or stale_p:
+            log(f"pod diff: missing={miss_p} stale={stale_p}")
+        if drift:
+            log(f"DEVICE-MIRROR DRIFT: {drift}")
+
+    try:
+        signal.signal(signal.SIGUSR2, handler)
+        return True
+    except (ValueError, OSError):
+        return False

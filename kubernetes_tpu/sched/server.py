@@ -97,6 +97,14 @@ class SchedulerServer:
         framework = None
         extenders = ()
         queue = None
+        if config is not None and scheduler is not None:
+            # a pre-built Scheduler already fixed its queue/framework/
+            # extenders — applying only the remainder of the config would be
+            # a silently half-applied configuration
+            raise ValueError(
+                "pass either a pre-built scheduler OR a config; a config's "
+                "queue/framework/extender wiring cannot be grafted onto an "
+                "existing Scheduler")
         if config is not None:
             from kubernetes_tpu.extender.client import HTTPExtender
             from kubernetes_tpu.sched.config import (
@@ -280,6 +288,11 @@ class SchedulerServer:
         self.pod_informer.wait_for_sync()
         if self.elector is not None:
             self.elector.start()
+        # SIGUSR2 cache dump/compare (internal/cache/debugger/debugger.go:55)
+        from kubernetes_tpu.sched.debugger import CacheComparer, install_sigusr2
+
+        self.comparer = CacheComparer(self.scheduler.cache, self.client)
+        install_sigusr2(self.comparer)
         t = threading.Thread(target=self._loop, daemon=True,
                              name="scheduler-loop")
         t.start()

@@ -54,6 +54,9 @@ class NodeArrays(NamedTuple):
     port_pair_wild: Array # [N, PWp] u32 — (proto,port) used with wildcard IP
     port_triple: Array    # [N, PWt] u32 — (proto,port,ip) exact triples in use
     img_words: Array      # [N, IW] u32 — image-presence bitset (ImageLocality)
+    vol_any: Array        # [N, VW] u32 — volumes attached by pods on the node
+    vol_rw: Array         # [N, VW] u32 — volumes attached read-write
+    vol_limit: Array      # [N, DR] i32 — per-driver attach limits, -1 unlimited
 
 
 class ReqTable(NamedTuple):
@@ -104,6 +107,17 @@ class PortSetTable(NamedTuple):
     trip_words: Array  # [SPP, PWt] u32 — union of triple bits
 
 
+class VolSetTable(NamedTuple):
+    """Distinct attachable-volume sets (NoDiskConflict + max-volume-count;
+    predicates.go:156-221, csi_volume_predicate.go:89). Bitsets are over the
+    volume vocab; per-driver occupancy is DERIVED from bitsets by popcount
+    against `ClusterTables.drv_masks`, so the engines carry only two [N, VW]
+    words per node."""
+
+    any_words: Array  # [SV, VW] u32 — all volumes in the set
+    rw_words: Array   # [SV, VW] u32 — volumes mounted read-write
+
+
 class TermTable(NamedTuple):
     """Interned pod-affinity / anti-affinity / topology-spread terms:
     (label selector, concrete namespace set, topology key)."""
@@ -140,6 +154,7 @@ class PodClassTable(NamedTuple):
     tsc_key: Array      # [SC, TS] i32 topo-key index
     tsc_maxskew: Array  # [SC, TS] i32
     tsc_hard: Array     # [SC, TS] bool (DoNotSchedule)
+    volset: Array       # [SC] i32 → VolSetTable, -1 = no attachable volumes
     ssel_terms: Array   # [SC, SS] i32 → TermTable (SelectorSpread owners), -1 pad
     img_ids: Array      # [SC, CI] i32 → image vocab (ImageLocality), -1 pad
     lim_rid: Array      # [SC] i32 → ReqTable (container limits), -1 none
@@ -179,3 +194,5 @@ class ClusterTables(NamedTuple):
     classes: PodClassTable
     images: ImageTable
     zone_keys: Array  # [2] i32 topo-key ids (modern, legacy zone label), -1 absent
+    volsets: VolSetTable
+    drv_masks: Array  # [DR, VW] u32 — which volume-vocab bits belong to driver d

@@ -433,6 +433,7 @@ class SchedulerCache:
             "terms": len(encoder.term_reg),
             "classes": len(encoder.class_reg),
             "images": len(encoder.vocabs.images),
+            "volsets": len(encoder.volset_reg),
         }
 
     def _existing_pod_arrays(self, d: Dims) -> PodArrays:
@@ -502,6 +503,8 @@ class SchedulerCache:
             classes=encoder.build_class_table(d),
             images=encoder.build_image_table(d),
             zone_keys=encoder.build_zone_keys(),
+            volsets=encoder.build_volset_table(d),
+            drv_masks=encoder.build_drv_masks(d),
         )
         pe = encoder.build_pod_arrays(list(pending), d, self._node_slot,
                                       capacity=d.P)
@@ -566,6 +569,7 @@ class SchedulerCache:
                 "terms": encoder.build_term_table,
                 "classes": encoder.build_class_table,
                 "images": encoder.build_image_table,
+                "volsets": encoder.build_volset_table,
             }
             tables = tables._replace(**{
                 k: jax.device_put(builders[k](d))

@@ -61,6 +61,10 @@ class Dims:
     CI: int = 4       # container images per pod (ImageLocality)
     IMG: int = 8      # interned container images
     IW: int = 1       # image-presence bitset words (32 images per word)
+    VS: int = 2       # attachable volumes per pod
+    SV: int = 4       # distinct volume sets
+    VW: int = 1       # volume bitset words (32 volumes per word)
+    DR: int = 2       # volume drivers
     S: int = 8        # interned pod-selector term table size
     SR: int = 8       # distinct request vectors
     SL: int = 8       # distinct pod label sets

@@ -103,6 +103,8 @@ class Encoder:
         self._max_node_taints = 1
         self._node_domains_done: Dict[int, tuple] = {}
         self.image_sizes: List[int] = []  # KiB, parallel to vocabs.images
+        self.volset_reg = Vocab()   # sorted ((vol_id, driver_id, ro), …)
+        self.vol_driver: List[int] = []  # driver id per volume vocab id
 
     # ---------------- sub-object interning ---------------- #
 
@@ -185,6 +187,24 @@ class Encoder:
             self.image_sizes[i] = size_kib
         return i
 
+    def volume_id(self, vol) -> int:
+        """Intern one VolumeRef's (driver, id) identity; the driver of a
+        volume is part of its identity (a PD name and an EBS id never
+        collide)."""
+        did = self.vocabs.vol_drivers.intern(vol.driver)
+        before = len(self.vocabs.volumes)
+        vid = self.vocabs.volumes.intern((vol.driver, vol.vol_id))
+        if vid == before:
+            self.vol_driver.append(did)
+        return vid
+
+    def volset_id(self, vols) -> int:
+        key = tuple(sorted(
+            (self.volume_id(v), self.vocabs.vol_drivers.intern(v.driver),
+             bool(v.read_only))
+            for v in vols))
+        return self.volset_reg.intern(key)
+
     def class_id(self, p: Pod) -> int:
         ns_id = self.vocabs.namespaces.intern(p.namespace)
         rid = self.req_id(p.requests)
@@ -231,8 +251,9 @@ class Encoder:
         imgs = tuple(self.image_id(nm) for nm in p.images)
         lim = (self.req_id(p.limits)
                if (p.limits.milli_cpu or p.limits.memory_kib) else -1)
+        vols = self.volset_id(p.volumes) if p.volumes else -1
         spec = (ns_id, rid, ls, nsel, aff_active, nterms, pterms, tol, ports,
-                aff, anti, paff, panti, tsc, ssel, imgs, lim)
+                aff, anti, paff, panti, tsc, ssel, imgs, lim, vols)
         before = len(self.class_reg)
         cid = self.class_reg.intern(spec)
         if cid == before:
@@ -371,6 +392,11 @@ class Encoder:
             CI=mx([len(s[15]) for s in self._class_spec]),
             IMG=max(len(self.vocabs.images), 1),
             IW=(len(self.vocabs.images) + 31) // 32 or 1,
+            VS=mx([len(self.volset_reg.lookup(i))
+                   for i in range(len(self.volset_reg))]),
+            SV=max(len(self.volset_reg), 1),
+            VW=(len(self.vocabs.volumes) + 31) // 32 or 1,
+            DR=max(len(self.vocabs.vol_drivers), 1),
             S=max(len(self.term_reg), 1),
             SR=max(len(self.req_reg), 1),
             SL=max(len(self.labelset_reg), 1),
@@ -497,12 +523,13 @@ class Encoder:
             panti_terms=z((SC, d.PAN), -1), panti_w=z((SC, d.PAN)),
             tsc_term=z((SC, d.TS), -1), tsc_key=z((SC, d.TS), -1),
             tsc_maxskew=z((SC, d.TS)), tsc_hard=z((SC, d.TS), False, bool),
+            volset=z((SC,), -1),
             ssel_terms=z((SC, d.SS), -1), img_ids=z((SC, d.CI), -1),
             lim_rid=z((SC,), -1),
         )
         for i, spec in enumerate(self._class_spec):
             (ns_id, rid, ls, nsel, aff_active, nterms, pterms, tol, ports,
-             aff, anti, paff, panti, tsc, ssel, imgs, lim) = spec
+             aff, anti, paff, panti, tsc, ssel, imgs, lim, vols) = spec
             t["valid"][i] = True
             t["ns"][i], t["rid"][i], t["labelset"][i] = ns_id, rid, ls
             t["nsel_term"][i] = nsel
@@ -528,7 +555,29 @@ class Encoder:
             for ti, x in enumerate(imgs):
                 t["img_ids"][i, ti] = x
             t["lim_rid"][i] = lim
+            t["volset"][i] = vols
         return PodClassTable(**t)
+
+    def build_volset_table(self, d: Dims) -> "VolSetTable":
+        from .arrays import VolSetTable
+
+        any_w = np.zeros((d.SV, d.VW), U32)
+        rw_w = np.zeros((d.SV, d.VW), U32)
+        for i in range(len(self.volset_reg)):
+            for vid, _did, ro in self.volset_reg.lookup(i):
+                _set_bit(any_w[i], vid)
+                if not ro:
+                    _set_bit(rw_w[i], vid)
+        return VolSetTable(any_words=any_w, rw_words=rw_w)
+
+    def build_drv_masks(self, d: Dims) -> np.ndarray:
+        """[DR, VW] u32: which volume-vocab bits belong to each driver —
+        lets per-driver attach counts be popcounts over the node's live
+        volume bitset instead of separate carried counters."""
+        masks = np.zeros((d.DR, d.VW), U32)
+        for vid, did in enumerate(self.vol_driver):
+            _set_bit(masks[did], vid)
+        return masks
 
     def build_image_table(self, d: Dims) -> "ImageTable":
         from .arrays import ImageTable
@@ -593,11 +642,16 @@ class Encoder:
                 arrays.topo[i, ki] = vid
                 arrays.domain[i, ki] = self.domain_maps[ki][vid]
 
+        arrays.vol_limit[i] = -1
+        for drv, lim in n.volume_limits.items():
+            arrays.vol_limit[i, self.vocabs.vol_drivers.intern(drv)] = lim
         used = arrays.used[i]
         used[:] = 0
         arrays.port_pair_any[i] = 0
         arrays.port_pair_wild[i] = 0
         arrays.port_triple[i] = 0
+        arrays.vol_any[i] = 0
+        arrays.vol_rw[i] = 0
         for p in pods_on_node:
             spec = self._class_spec[self.pod_row(p)[2]]
             cpu, mem, eph, scalars = self.req_reg.lookup(spec[1])
@@ -615,6 +669,12 @@ class Encoder:
                         _set_bit(arrays.port_pair_wild[i], pair)
                     elif trip >= 0:
                         _set_bit(arrays.port_triple[i], trip)
+            vols_id = spec[17]
+            if vols_id >= 0:
+                for vid, _did, ro in self.volset_reg.lookup(vols_id):
+                    _set_bit(arrays.vol_any[i], vid)
+                    if not ro:
+                        _set_bit(arrays.vol_rw[i], vid)
 
     @staticmethod
     def empty_node_arrays(d: Dims) -> NodeArrays:
@@ -638,6 +698,9 @@ class Encoder:
             port_pair_wild=np.zeros((N, d.PWp), U32),
             port_triple=np.zeros((N, d.PWt), U32),
             img_words=np.zeros((N, d.IW), U32),
+            vol_any=np.zeros((N, d.VW), U32),
+            vol_rw=np.zeros((N, d.VW), U32),
+            vol_limit=np.full((N, d.DR), -1, I32),
         )
 
     def build_node_arrays(
@@ -709,6 +772,8 @@ class Encoder:
             classes=self.build_class_table(d),
             images=self.build_image_table(d),
             zone_keys=self.build_zone_keys(),
+            volsets=self.build_volset_table(d),
+            drv_masks=self.build_drv_masks(d),
         )
         ex = self.build_pod_arrays(existing, d, node_index, capacity=d.E)
         pe = self.build_pod_arrays(pending, d, node_index, capacity=d.P)

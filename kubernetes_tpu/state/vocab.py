@@ -77,3 +77,5 @@ class VocabSet:
         self.port_pairs = Vocab()  # (protocol, port)
         self.port_triples = Vocab()  # (protocol, port, ip) with ip != wildcard
         self.images = Vocab()  # container image names (ImageLocality)
+        self.volumes = Vocab()  # (driver, volume id) attachable volumes
+        self.vol_drivers = Vocab()  # volume driver/plugin names

@@ -1,0 +1,154 @@
+"""kube-aggregator APIService proxying + the cache debugger/comparer."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver import aggregator
+from kubernetes_tpu.apiserver.server import handle_rest
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.machinery import errors
+
+
+@pytest.fixture
+def api():
+    a = APIServer()
+    yield a
+    a.close()
+
+
+class TestAggregator:
+    def _apiservice(self, api, name="v1beta1.metrics.example.com", url=""):
+        spec = {"group": name.split(".", 1)[1], "version": name.split(".")[0],
+                "groupPriorityMinimum": 100, "versionPriority": 10}
+        if url:
+            spec["externalURL"] = url
+        api.store("apiregistration.k8s.io", "apiservices").create(
+            "", {"apiVersion": "apiregistration.k8s.io/v1",
+                 "kind": "APIService",
+                 "metadata": {"name": name}, "spec": spec})
+
+    def test_unclaimed_group_stays_404(self, api):
+        with pytest.raises(errors.StatusError) as ei:
+            handle_rest(api, "GET",
+                        "/apis/metrics.example.com/v1beta1/nodemetrics",
+                        {}, None)
+        assert errors.is_not_found(ei.value)
+
+    def test_proxies_to_local_backend(self, api):
+        """An APIService claims the group; requests route to its backend
+        (proxyHandler.ServeHTTP analog; in-process handler stands in for the
+        HTTP hop)."""
+        self._apiservice(api)
+        calls = []
+
+        def backend(method, path, query, body):
+            calls.append((method, path))
+            return 200, {"kind": "NodeMetricsList", "items": [{"usage": 7}]}
+
+        aggregator.register_local_backend("v1beta1.metrics.example.com",
+                                          backend)
+        try:
+            code, obj = handle_rest(
+                api, "GET", "/apis/metrics.example.com/v1beta1/nodemetrics",
+                {}, None)
+            assert code == 200
+            assert obj["kind"] == "NodeMetricsList"
+            assert calls and calls[0][0] == "GET"
+        finally:
+            aggregator.unregister_local_backend("v1beta1.metrics.example.com")
+
+    def test_proxies_over_http(self, api):
+        """Full HTTP hop: aggregated server is a real listening gateway."""
+        import http.server
+        import json
+        import threading
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                payload = json.dumps({"kind": "Echo", "path": self.path})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(payload.encode())
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            self._apiservice(api, "v1.custom.example.com",
+                             url=f"http://127.0.0.1:{srv.server_port}")
+            code, obj = handle_rest(
+                api, "GET", "/apis/custom.example.com/v1/widgets", {}, None)
+            assert code == 200 and obj["kind"] == "Echo"
+            assert obj["path"].endswith("/apis/custom.example.com/v1/widgets")
+        finally:
+            srv.shutdown()
+
+    def test_backend_unreachable_is_503(self, api):
+        self._apiservice(api, "v1.down.example.com",
+                         url="http://127.0.0.1:1")  # nothing listens
+        with pytest.raises(errors.StatusError) as ei:
+            handle_rest(api, "GET", "/apis/down.example.com/v1/things",
+                        {}, None)
+        assert ei.value.code == 503
+
+
+class TestCacheDebugger:
+    def _sched(self):
+        from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+        from kubernetes_tpu.api.types import Node, Pod, Resources
+
+        s = Scheduler(binder=RecordingBinder())
+        for i in range(3):
+            s.on_node_add(Node(name=f"n{i}",
+                               allocatable=Resources.make(cpu="4",
+                                                          memory="8Gi",
+                                                          pods=10)))
+        s.on_pod_add(Pod(name="p0", node_name="n1",
+                         requests=Resources.make(cpu="100m", memory="64Mi")))
+        return s
+
+    def test_dump_lists_nodes_and_pods(self):
+        from kubernetes_tpu.sched.debugger import CacheComparer
+
+        s = self._sched()
+        out = CacheComparer(s.cache).dump()
+        assert "node n1: default/p0" in out
+        assert "node n0: -" in out
+
+    def test_verify_staging_clean_and_drifted(self):
+        """The device-mirror drift detector: clean after snapshots; flags a
+        corrupted staging row (the cache-corruption Fatalf analog)."""
+        import numpy as np
+
+        from kubernetes_tpu.sched.cycle import snapshot_with_keys
+        from kubernetes_tpu.sched.debugger import CacheComparer
+        from kubernetes_tpu.api.types import Pod, Resources
+
+        s = self._sched()
+        pending = [Pod(name="x",
+                       requests=Resources.make(cpu="100m", memory="64Mi"))]
+        snapshot_with_keys(s.cache, s.encoder, pending, None)
+        comparer = CacheComparer(s.cache)
+        assert comparer.verify_staging() == []
+        # corrupt one staged row the way a buggy patch path would
+        s.cache._staging_nodes.used[s.cache._node_slot["n1"], 0] += 999
+        drift = comparer.verify_staging()
+        assert any("n1" in d and "used" in d for d in drift)
+
+    def test_comparer_against_apiserver(self, api):
+        from kubernetes_tpu.sched.debugger import CacheComparer
+
+        client = Client.local(api)
+        client.nodes.create({"apiVersion": "v1", "kind": "Node",
+                             "metadata": {"name": "api-only"}, "spec": {}})
+        s = self._sched()
+        comparer = CacheComparer(s.cache, client)
+        missing, stale = comparer.compare_nodes()
+        assert missing == ["api-only"]
+        assert set(stale) == {"n0", "n1", "n2"}

@@ -404,3 +404,111 @@ def _exists(rc, name, ns="default"):
         return True
     except errors.StatusError:
         return False
+
+
+class TestHorizontalPodAutoscaler:
+    """podautoscaler/horizontal.go: scale by usage ratio within tolerance."""
+
+    def _setup(self, client, replicas=2, max_r=8):
+        client.deployments.create(deployment("web", replicas=replicas))
+        client.horizontalpodautoscalers.create(
+            {"apiVersion": "autoscaling/v1",
+             "kind": "HorizontalPodAutoscaler",
+             "metadata": {"name": "web", "namespace": "default"},
+             "spec": {"scaleTargetRef": {"kind": "Deployment",
+                                         "name": "web"},
+                      "minReplicas": 1, "maxReplicas": max_r,
+                      "targetCPUUtilizationPercentage": 50}})
+
+    def _set_utilization(self, client, pct):
+        for pod in client.pods.list("default")["items"]:
+            pod.setdefault("metadata", {}).setdefault("annotations", {})[
+                "kubernetes-tpu.io/cpu-utilization"] = str(pct)
+            client.pods.update(pod)
+
+    def test_scales_up_on_high_utilization(self, client, cm):
+        # cap at 6 so the first usage-ratio step (ceil(2 × 150/50) = 6) is
+        # also the fixed point — persistent high metrics would otherwise
+        # keep compounding toward any higher cap, like the reference
+        self._setup(client, replicas=2, max_r=6)
+        assert wait_for(lambda: len(client.pods.list("default")["items"]) == 2)
+        self._set_utilization(client, 150)  # 3x the 50% target
+        assert wait_for(lambda: client.deployments.get("web")
+                        ["spec"]["replicas"] == 6)
+        st = client.horizontalpodautoscalers.get("web").get("status", {})
+        assert st.get("desiredReplicas") == 6
+
+    def test_within_tolerance_no_scale(self, client, cm):
+        self._setup(client, replicas=2)
+        assert wait_for(lambda: len(client.pods.list("default")["items"]) == 2)
+        self._set_utilization(client, 52)  # ratio 1.04 < 1.1 tolerance
+        time.sleep(1.0)
+        assert client.deployments.get("web")["spec"]["replicas"] == 2
+
+    def test_max_replicas_caps(self, client, cm):
+        self._setup(client, replicas=2)
+        assert wait_for(lambda: len(client.pods.list("default")["items"]) == 2)
+        self._set_utilization(client, 500)  # would want 20; max is 8
+        assert wait_for(lambda: client.deployments.get("web")
+                        ["spec"]["replicas"] == 8)
+
+
+class TestAttachDetach:
+    def test_node_status_tracks_pod_volumes(self, client, cm):
+        client.nodes.create({"apiVersion": "v1", "kind": "Node",
+                             "metadata": {"name": "n0"}, "spec": {}})
+        client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p0", "namespace": "default"},
+            "spec": {"nodeName": "n0",
+                     "containers": [{"name": "c", "image": "i"}],
+                     "volumes": [{"name": "data",
+                                  "gcePersistentDisk": {"pdName": "disk-1"}}]}})
+
+        def attached():
+            n = client.nodes.get("n0")
+            vs = [v["name"] for v in n.get("status", {})
+                  .get("volumesAttached", [])]
+            return vs == ["kubernetes.io/gcePersistentDisk/disk-1"]
+        assert wait_for(attached)
+        # pod removed → volume detaches
+        client.pods.delete("p0", "default")
+        assert wait_for(lambda: client.nodes.get("n0").get("status", {})
+                        .get("volumesAttached") == [])
+
+
+class TestVolumeExpansion:
+    def test_pvc_growth_expands_pv(self, client, cm):
+        client.persistentvolumes.create({
+            "apiVersion": "v1", "kind": "PersistentVolume",
+            "metadata": {"name": "pv1"},
+            "spec": {"capacity": {"storage": "1Gi"},
+                     "accessModes": ["ReadWriteOnce"]}})
+        client.persistentvolumeclaims.create({
+            "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+            "metadata": {"name": "c1", "namespace": "default"},
+            "spec": {"volumeName": "pv1", "accessModes": ["ReadWriteOnce"],
+                     "resources": {"requests": {"storage": "2Gi"}}},
+            "status": {"capacity": {"storage": "1Gi"}}})
+
+        def grown():
+            pv = client.persistentvolumes.get("pv1")
+            from kubernetes_tpu.api.types import parse_mem_kib
+            return parse_mem_kib(pv["spec"]["capacity"]["storage"]) \
+                >= 2 * 1024 * 1024
+        assert wait_for(grown)
+
+
+class TestNodeIpam:
+    def test_each_node_gets_unique_pod_cidr(self, client, cm):
+        for i in range(3):
+            client.nodes.create({"apiVersion": "v1", "kind": "Node",
+                                 "metadata": {"name": f"n{i}"}, "spec": {}})
+
+        def all_assigned():
+            cidrs = [client.nodes.get(f"n{i}").get("spec", {}).get("podCIDR")
+                     for i in range(3)]
+            return all(cidrs) and len(set(cidrs)) == 3
+        assert wait_for(all_assigned)
+        cidr = client.nodes.get("n0")["spec"]["podCIDR"]
+        assert cidr.startswith("10.244.") and cidr.endswith("/24")

@@ -15,6 +15,7 @@ from kubernetes_tpu.api import semantics as sem
 from kubernetes_tpu.api.types import (
     Affinity,
     HostPort,
+    VolumeRef,
     LabelSelector,
     Node,
     NodeSelector,
@@ -73,8 +74,10 @@ def rand_node(rng, i):
         Taint(rng.choice(KEYS), rng.choice(VALS), rng.choice(EFFECTS))
         for _ in range(rng.randint(0, 2))
     )
+    volume_limits = {"pd": rng.randint(1, 3)} if rng.random() < 0.4 else {}
     return Node(
         name=f"n{i}",
+        volume_limits=volume_limits,
         labels=labels,
         allocatable=Resources.make(
             cpu=rng.choice(["1", "2", "4"]),
@@ -143,6 +146,12 @@ def rand_pod(rng, i, bound_to=None):
     if rng.random() < 0.25:
         ports = (HostPort(rng.choice([80, 8080]), "TCP",
                           rng.choice(["", "10.0.0.1"])),)
+    vols = ()
+    if rng.random() < 0.3:
+        vols = tuple(
+            VolumeRef(vol_id=rng.choice(["v1", "v2", "v3", "v4"]),
+                      driver="pd", read_only=rng.random() < 0.4)
+            for _ in range(rng.randint(1, 2)))
     return Pod(
         name=f"p{i}",
         namespace=rng.choice(["default", "kube-system"]),
@@ -157,6 +166,7 @@ def rand_pod(rng, i, bound_to=None):
         tolerations=tuple(rand_toleration(rng) for _ in range(rng.randint(0, 2))),
         topology_spread=spread,
         host_ports=ports,
+        volumes=vols,
         node_name=bound_to or "",
         creation_index=i,
     )
@@ -169,6 +179,7 @@ def oracle_fits(pod, node, nodes, existing):
     used = Resources()
     used_pods = 0
     used_ports = []
+    node_pods = []
     agg = {"cpu": 0, "mem": 0, "eph": 0, "scalars": {}}
     for ex in existing:
         if ex.node_name != node.name:
@@ -180,6 +191,7 @@ def oracle_fits(pod, node, nodes, existing):
         for k, v in ex.requests.scalars:
             agg["scalars"][k] = agg["scalars"].get(k, 0) + v
         used_ports.extend(ex.host_ports)
+        node_pods.append(ex)
     used = Resources(
         milli_cpu=agg["cpu"], memory_kib=agg["mem"], ephemeral_kib=agg["eph"],
         scalars=tuple(sorted(agg["scalars"].items())),
@@ -194,6 +206,8 @@ def oracle_fits(pod, node, nodes, existing):
         and sem.pod_tolerates_node_taints(pod, node)
         and sem.interpod_affinity_fits(pod, node, nodes_by_name, existing)
         and sem.topology_spread_fits(pod, node, nodes, existing)
+        and sem.no_disk_conflict(pod, node_pods)
+        and sem.max_volume_count_fits(pod, node, node_pods)
     )
 
 
