@@ -189,14 +189,17 @@ class AuthGate:
         self.always_allow_paths = always_allow_paths
 
     def check(self, method: str, path: str, query: Dict[str, str],
-              headers: Dict[str, str]) -> None:
+              headers: Dict[str, str]) -> str:
+        """Raises on deny; returns the authenticated username (audit
+        attribution — the reference threads user.Info through the request
+        context for exactly this)."""
         if self.authenticator is None:
-            return
+            return ""
         if path in self.always_allow_paths:
-            return
+            return ""
         user = self.authenticator.authenticate(headers)
         if self.authorizer is None:
-            return
+            return user.name
         attrs = attributes_from_request(user, method, path, query)
         if not self.authorizer.authorize(attrs):
             raise errors.new_forbidden(
@@ -205,3 +208,4 @@ class AuthGate:
                 f'"{attrs.resource}" in API group "{attrs.api_group}"'
                 + (f' in the namespace "{attrs.namespace}"'
                    if attrs.namespace else ""))
+        return user.name

@@ -252,6 +252,14 @@ def build_scheme() -> Scheme:
     # ---- coordination (leader-election leases) ----
     s.register(R("coordination.k8s.io", "v1", "Lease", "leases"))
 
+    # --- admission webhooks (admissionregistration.k8s.io) ---
+    s.register(R("admissionregistration.k8s.io", "v1",
+                 "MutatingWebhookConfiguration",
+                 "mutatingwebhookconfigurations", namespaced=False))
+    s.register(R("admissionregistration.k8s.io", "v1",
+                 "ValidatingWebhookConfiguration",
+                 "validatingwebhookconfigurations", namespaced=False))
+
     # --- aggregation (kube-aggregator APIService registry) ---
     s.register(R("apiregistration.k8s.io", "v1", "APIService", "apiservices",
                  namespaced=False, subresources=("status",)))

@@ -60,6 +60,14 @@ class APIServer:
         if hasattr(admission, "attach"):
             admission.attach(self)
         self.admission = admission
+        from kubernetes_tpu.apiserver.webhooks import AuditLog, WebhookDispatcher
+
+        self._webhooks = WebhookDispatcher(self)
+        # audit backend (apiserver/pkg/audit): ring + optional JSONL file via
+        # KTPU_AUDIT_LOG
+        import os as _os
+
+        self.audit = AuditLog(path=_os.environ.get("KTPU_AUDIT_LOG"))
         self._stores: Dict[Tuple[str, str], Store] = {}
         for info in self.scheme.resources():
             self._install(info)
@@ -81,7 +89,13 @@ class APIServer:
     def _admit(self, op: str, info: ResourceInfo, obj: Optional[Obj],
                old: Optional[Obj]) -> Optional[Obj]:
         if self.admission is not None:
-            return self.admission(op, info, obj, old)
+            obj = self.admission(op, info, obj, old)
+        # webhook admission runs AFTER the compiled-in chain (the reference
+        # orders MutatingAdmissionWebhook/ValidatingAdmissionWebhook at the
+        # end of the default plugin order); skip for the webhook config
+        # resources themselves to avoid self-administering registrations
+        if info.group != "admissionregistration.k8s.io":
+            obj = self._webhooks.dispatch(op, info, obj, old)
         return obj
 
     def close(self) -> None:
@@ -270,9 +284,50 @@ class APIServer:
 # --------------------------------------------------------------------------- #
 
 
+_AUDIT_VERBS = {"POST": "create", "PUT": "update", "PATCH": "patch",
+                "DELETE": "delete"}
+
+
 def handle_rest(api: APIServer, method: str, path: str,
-                query: Dict[str, str], body: Optional[Obj]):
-    """Route one REST request. Returns (code, obj) or ("WATCH", Watch)."""
+                query: Dict[str, str], body: Optional[Obj], user: str = ""):
+    """Route one REST request. Returns (code, obj) or ("WATCH", Watch).
+    Mutations are audited at this chokepoint (stage ResponseComplete), both
+    outcomes — the reference's audit filter sits in the same position in the
+    handler chain."""
+    if method not in _AUDIT_VERBS:
+        return _handle_rest_inner(api, method, path, query, body)
+    body_name = meta.name(body) if isinstance(body, dict) else ""
+    try:
+        out = _handle_rest_inner(api, method, path, query, body)
+    except errors.StatusError as e:
+        _audit(api, method, path, e.code, user, body_name)
+        raise
+    code = out[0] if isinstance(out[0], int) else 200
+    _audit(api, method, path, code, user, body_name)
+    return out
+
+
+def _audit(api: APIServer, method: str, path: str, code: int,
+           user: str, body_name: str = "") -> None:
+    parts = [p for p in path.split("/") if p]
+    ns = name = resource = ""
+    try:
+        rest = parts[2:] if parts[0] == "api" else parts[3:]
+        # same namespaces-subresource exception as the router: finalize/
+        # status on a namespace addresses the namespace itself
+        if rest and rest[0] == "namespaces" and len(rest) >= 3 and not (
+                len(rest) == 3 and rest[2] in ("finalize", "status")):
+            ns, rest = rest[1], rest[2:]
+        resource = rest[0] if rest else ""
+        name = rest[1] if len(rest) > 1 else ""
+    except IndexError:
+        pass
+    api.audit.record(_AUDIT_VERBS[method], resource, ns, name or body_name,
+                     code, user)
+
+
+def _handle_rest_inner(api: APIServer, method: str, path: str,
+                       query: Dict[str, str], body: Optional[Obj]):
     parts = [p for p in path.split("/") if p]
     if not parts:
         return 200, {"paths": ["/api", "/apis", "/healthz", "/metrics",
@@ -417,10 +472,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, errors.new_bad_request("invalid JSON").status())
                 return
         try:
+            user = ""
             if auth_gate is not None:
-                auth_gate.check(method, parsed.path, query,
-                                dict(self.headers.items()))
-            result = handle_rest(api, method, parsed.path, query, body)
+                user = auth_gate.check(method, parsed.path, query,
+                                       dict(self.headers.items())) or ""
+            result = handle_rest(api, method, parsed.path, query, body,
+                                 user=user)
         except errors.StatusError as e:
             self._reply(e.code, e.status())
             return

@@ -1,0 +1,215 @@
+"""Webhook admission + audit logging — the apiserver library's remaining
+handler-chain tiers (SURVEY §2.2 "apiserver library": handler chain
+(auth/n, auth/z, admission webhooks, audit)).
+
+Webhook admission ⇔ plugin/pkg/admission/webhook/{mutating,validating}:
+`MutatingWebhookConfiguration` / `ValidatingWebhookConfiguration` objects
+register webhooks with resource rules; matching requests POST an
+AdmissionReview to the webhook and apply its AdmissionResponse (patches for
+mutating, allow/deny for both). As with the aggregation layer
+(docs/PARITY.md #12), backends are addressed by `url` in clientConfig (or an
+in-process handler for tests) — there is no cluster network to resolve a
+service reference through. failurePolicy Ignore/Fail is honored.
+
+Audit ⇔ staging/src/k8s.io/apiserver/pkg/audit: every REST mutation emits a
+structured event (stage ResponseComplete) to a pluggable sink — an in-memory
+ring by default, a JSONL file when `audit_path` is set (the reference's log
+backend).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.machinery import errors, meta
+
+Obj = Dict[str, Any]
+
+# in-process webhook backends: url → handler(review) → response dict
+_LOCAL_WEBHOOKS: Dict[str, Callable] = {}
+
+
+def register_local_webhook(url: str, handler: Callable) -> None:
+    _LOCAL_WEBHOOKS[url] = handler
+
+
+def unregister_local_webhook(url: str) -> None:
+    _LOCAL_WEBHOOKS.pop(url, None)
+
+
+def _rule_matches(rule: Obj, op: str, info) -> bool:
+    ops = rule.get("operations", ["*"])
+    if "*" not in ops and op not in ops:
+        return False
+    groups = rule.get("apiGroups", ["*"])
+    if "*" not in groups and info.group not in groups:
+        return False
+    resources = rule.get("resources", ["*"])
+    return "*" in resources or info.resource in resources
+
+
+def _call_webhook(cfg_url: str, review: Obj, timeout: float) -> Obj:
+    local = _LOCAL_WEBHOOKS.get(cfg_url)
+    if local is not None:
+        return local(review)
+    import urllib.request
+
+    req = urllib.request.Request(
+        cfg_url, data=json.dumps(review).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _apply_json_patch(obj: Obj, patch: List[Obj]) -> Obj:
+    """The subset of RFC 6902 mutating webhooks emit (add/replace/remove on
+    simple paths)."""
+    import copy
+
+    out = copy.deepcopy(obj)
+    for op in patch:
+        parts = [p.replace("~1", "/").replace("~0", "~")
+                 for p in op.get("path", "").strip("/").split("/") if p != ""]
+        tgt = out
+        for p in parts[:-1]:
+            if isinstance(tgt, list):
+                tgt = tgt[int(p)]
+            else:
+                tgt = tgt.setdefault(p, {})
+        leaf = parts[-1] if parts else None
+        kind = op.get("op")
+        if kind in ("add", "replace"):
+            if isinstance(tgt, list):
+                if leaf == "-":
+                    tgt.append(op.get("value"))
+                else:
+                    tgt.insert(int(leaf), op.get("value")) if kind == "add" \
+                        else tgt.__setitem__(int(leaf), op.get("value"))
+            elif leaf is None:
+                out = op.get("value")
+            else:
+                tgt[leaf] = op.get("value")
+        elif kind == "remove" and leaf is not None:
+            if isinstance(tgt, list):
+                del tgt[int(leaf)]
+            else:
+                tgt.pop(leaf, None)
+    return out
+
+
+class WebhookDispatcher:
+    """Runs matching mutating then validating webhooks for one admission
+    attempt (webhook/mutating/dispatcher.go + validating/dispatcher.go)."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def _configs(self, kind_plural: str) -> List[Obj]:
+        try:
+            store = self.api.store("admissionregistration.k8s.io", kind_plural)
+        except errors.StatusError:
+            return []  # resource not registered ⇒ genuinely no webhooks
+        # zero-config short-circuit: one O(1) count beats listing + decoding
+        # both config prefixes on every mutation (the reference keeps a
+        # watch-fed cached config source for the same reason)
+        if store.storage.count(store.prefix_for("")) == 0:
+            return []
+        # storage failures fail CLOSED: admitting a mutation because the
+        # webhook configs could not be read would bypass a Fail-policy hook
+        objs, _ = store.storage.list(store.prefix_for(""))
+        return objs
+
+    def dispatch(self, op: str, info, obj: Optional[Obj],
+                 old: Optional[Obj]) -> Optional[Obj]:
+        for phase, plural in (("mutating", "mutatingwebhookconfigurations"),
+                              ("validating",
+                               "validatingwebhookconfigurations")):
+            for cfg in self._configs(plural):
+                for wh in cfg.get("webhooks", []) or []:
+                    if not any(_rule_matches(r, op, info)
+                               for r in wh.get("rules", []) or []):
+                        continue
+                    url = (wh.get("clientConfig", {}) or {}).get("url", "")
+                    policy = wh.get("failurePolicy", "Fail")
+                    timeout = float(wh.get("timeoutSeconds", 10))
+                    review = {
+                        "apiVersion": "admission.k8s.io/v1",
+                        "kind": "AdmissionReview",
+                        "request": {
+                            "operation": op,
+                            "resource": {"group": info.group,
+                                         "resource": info.resource},
+                            "namespace": meta.namespace(obj or old or {}),
+                            "name": meta.name(obj or old or {}),
+                            "object": obj, "oldObject": old,
+                        },
+                    }
+                    try:
+                        out = _call_webhook(url, review, timeout)
+                    except Exception as e:  # noqa: BLE001 — policy decides
+                        if policy == "Ignore":
+                            continue
+                        raise errors.new_service_unavailable(
+                            f"admission webhook {wh.get('name', url)} "
+                            f"failed: {e}")
+                    resp = out.get("response", {}) or {}
+                    if not resp.get("allowed", False):
+                        msg = (resp.get("status", {}) or {}).get(
+                            "message", "denied by admission webhook")
+                        raise errors.new_forbidden(
+                            info.resource, meta.name(obj or old or {}), msg)
+                    if phase == "mutating" and resp.get("patch") and \
+                            obj is not None:
+                        import base64
+
+                        try:
+                            patch = json.loads(base64.b64decode(resp["patch"]))
+                            obj = _apply_json_patch(obj, patch)
+                        except Exception as e:  # malformed patch = webhook
+                            # failure → failurePolicy decides, and callers
+                            # always see a StatusError
+                            if policy == "Ignore":
+                                continue
+                            raise errors.new_service_unavailable(
+                                f"admission webhook {wh.get('name', url)} "
+                                f"returned an unusable patch: {e}")
+        return obj
+
+
+class AuditLog:
+    """apiserver/pkg/audit log backend: ResponseComplete events to a ring
+    (and optionally a JSONL file)."""
+
+    def __init__(self, capacity: int = 4096, path: Optional[str] = None):
+        self._mu = threading.Lock()
+        self._ring = deque(maxlen=capacity)
+        self._path = path
+        self._seq = 0
+
+    def record(self, verb: str, resource: str, namespace: str, name: str,
+               code: int, user: str = "") -> None:
+        with self._mu:
+            self._seq += 1
+            ev = {
+                "kind": "Event", "apiVersion": "audit.k8s.io/v1",
+                "auditID": f"audit-{self._seq}",
+                "stage": "ResponseComplete",
+                "verb": verb, "user": {"username": user or "system:unknown"},
+                "objectRef": {"resource": resource, "namespace": namespace,
+                              "name": name},
+                "responseStatus": {"code": code},
+                "stageTimestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                time.gmtime()),
+            }
+            self._ring.append(ev)
+            if self._path:
+                with open(self._path, "a") as f:
+                    f.write(json.dumps(ev) + "\n")
+
+    def events(self) -> List[Obj]:
+        with self._mu:
+            return list(self._ring)

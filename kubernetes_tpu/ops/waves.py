@@ -61,6 +61,12 @@ from .lattice import CycleArrays
 _I32_MAX = int(jnp.iinfo(jnp.int32).max)
 _I32_MIN = int(jnp.iinfo(jnp.int32).min)
 
+# class-axis tile size for the per-wave dense evaluation (long-context
+# tiling; KTPU_CLASS_BLOCK overrides — the bench shapes stay un-tiled)
+import os as _os
+
+_CLASS_BLOCK = int(_os.environ.get("KTPU_CLASS_BLOCK", "1024"))
+
 
 class _WaveCarry(NamedTuple):
     state: AssignState
@@ -95,11 +101,17 @@ def interaction_graph(tables: ClusterTables, cyc: CycleArrays) -> Array:
 
 def _class_mask_score(tables, cyc, state):
     """[SC, N] Filter mask + Score for every class against `state` — the
-    dense analog of findNodesThatFit + prioritizeNodes, once per class."""
+    dense analog of findNodesThatFit + prioritizeNodes, once per class.
+
+    Long-context tiling (SURVEY §5 "blockwise tiles over the pod axis"):
+    vmapping the full row over SC materializes per-class intermediates like
+    [SC, S, N] domain gathers — fine at the class-interned SC of replicated
+    workloads, but with thousands of DISTINCT pod specs SC approaches P and
+    those temporaries outgrow HBM long before the [SC, N] outputs do. Above
+    _CLASS_BLOCK classes the vmap runs under lax.map over class blocks, so
+    peak intermediate memory is bounded by block size while outputs stay the
+    full lattice (the same shape the rest of the wave consumes)."""
     classes = tables.classes
-    nodes = tables.nodes
-    terms = tables.terms
-    D = cyc.ELD.shape[2] - 1
     SC = classes.valid.shape[0]
 
     def row(c):
@@ -108,7 +120,16 @@ def _class_mask_score(tables, cyc, state):
         score = score_row(tables, cyc, state, c)
         return mask, jnp.where(mask, score, -jnp.inf)
 
-    return jax.vmap(row)(jnp.arange(SC))
+    if SC <= _CLASS_BLOCK:
+        return jax.vmap(row)(jnp.arange(SC))
+    n_blocks = -(-SC // _CLASS_BLOCK)
+    blocks = jnp.arange(n_blocks * _CLASS_BLOCK, dtype=jnp.int32).reshape(
+        n_blocks, _CLASS_BLOCK)
+    # padded tail indexes clamp to SC-1; the duplicate rows are sliced off
+    masks, scores = lax.map(
+        lambda blk: jax.vmap(row)(jnp.minimum(blk, SC - 1)), blocks)
+    return (masks.reshape(-1, masks.shape[-1])[:SC],
+            scores.reshape(-1, scores.shape[-1])[:SC])
 
 
 def _domain_quota_pass(tables, cyc, state, mask, order_n, allowed_sorted):

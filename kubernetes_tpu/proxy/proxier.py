@@ -126,6 +126,32 @@ class RuleTable:
             lines.append("COMMIT")
             return "\n".join(lines)
 
+    def render_ipvs(self) -> str:
+        """The ipvsadm-restore document of the ipvs proxier
+        (pkg/proxy/ipvs/proxier.go:318 syncProxyRules): one virtual server
+        per ClusterIP:port with rr scheduling, the persistence flag for
+        ClientIP session affinity (ipvs VirtualServer.Flags persistence,
+        matching the reference — scheduling stays rr), and one masqueraded
+        real server per endpoint."""
+        with self._mu:
+            lines = []
+            proto_flag = {"TCP": "-t", "UDP": "-u", "SCTP": "--sctp-service"}
+            for (ns, name, pname), r in sorted(self.by_port.items()):
+                if not r.cluster_ip:
+                    continue
+                proto = proto_flag.get(r.protocol.upper(), "-t")
+                sched = "rr"
+                persist = ""
+                if r.session_affinity == "ClientIP":
+                    # ipvs persistence replaces the iptables recent-match
+                    persist = f" -p {r.affinity_timeout}"
+                lines.append(f"-A {proto} {r.cluster_ip}:{r.port} "
+                             f"-s {sched}{persist}")
+                for ep in r.endpoints:
+                    lines.append(f"-a {proto} {r.cluster_ip}:{r.port} "
+                                 f"-r {ep} -m")
+            return "\n".join(lines)
+
 
 class Proxier:
     """Watch-driven sync loop over Services + Endpoints."""

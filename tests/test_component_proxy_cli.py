@@ -150,6 +150,12 @@ class TestProxier:
         assert picks == {"10.0.0.1:8080", "10.0.0.2:8080"}
         rules = proxier.table.render_iptables()
         assert "-d 10.96.0.10/32" in rules and "10.0.0.2:8080" in rules
+        # ipvs variant renders the same table as virtual/real servers
+        # (ipvs/proxier.go:318)
+        ipvs = proxier.table.render_ipvs()
+        assert "-A -t 10.96.0.10:80 -s rr" in ipvs
+        assert "-a -t 10.96.0.10:80 -r 10.0.0.1:8080 -m" in ipvs
+        assert "-a -t 10.96.0.10:80 -r 10.0.0.2:8080 -m" in ipvs
         # endpoint removal reprograms
         ep = client.endpoints.get("web")
         ep["subsets"][0]["addresses"] = [{"ip": "10.0.0.1"}]

@@ -227,3 +227,32 @@ def test_waves_engine_beats_scan_floor():
     assert t_waves * 2 < t_scan, (
         f"waves engine no longer beats scan 2x: waves={t_waves:.3f}s "
         f"scan={t_scan:.3f}s")
+
+
+def test_class_axis_tiling_bit_identical(monkeypatch):
+    """Long-context tiling: with many DISTINCT pod specs the per-wave dense
+    evaluation runs blockwise over the class axis (lax.map) — results must be
+    bit-identical to the un-tiled vmap."""
+    from kubernetes_tpu.ops import waves as waves_mod
+
+    rng = random.Random(42)
+    nodes = [rand_node(rng, i) for i in range(8)]
+    # distinct creation labels force ~40 distinct classes
+    pending = []
+    for i in range(40):
+        p = rand_pod(rng, i)
+        p.labels = {**p.labels, "uniq": f"u{i}"}
+        pending.append(p)
+    tables, ex, pe, uk, ev, d = _encode(nodes, [], pending)
+
+    res_ref, _ = _run("waves", tables, ex, pe, uk, ev, d.D)
+    ref = np.asarray(res_ref.node)
+
+    monkeypatch.setattr(waves_mod, "_CLASS_BLOCK", 8)  # force ~5 blocks
+    jax.clear_caches()
+    try:
+        res_tiled, _ = _run("waves", tables, ex, pe, uk, ev, d.D)
+        np.testing.assert_array_equal(np.asarray(res_tiled.node), ref)
+    finally:
+        monkeypatch.undo()
+        jax.clear_caches()

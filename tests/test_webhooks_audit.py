@@ -1,0 +1,148 @@
+"""Webhook admission (mutating + validating) and audit logging."""
+
+import base64
+import json
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver import webhooks
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.machinery import errors
+
+
+def podspec(name, ns="default"):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"containers": [{"name": "c", "image": "img"}]}}
+
+
+@pytest.fixture
+def api():
+    a = APIServer()
+    yield a
+    a.close()
+
+
+@pytest.fixture
+def client(api):
+    return Client.local(api)
+
+
+def _register(client, kind, name, url, ops=("CREATE",), policy="Fail"):
+    plural = ("mutatingwebhookconfigurations" if kind == "Mutating"
+              else "validatingwebhookconfigurations")
+    client.resource("admissionregistration.k8s.io", "v1", plural,
+                    namespaced=False).create({
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": f"{kind}WebhookConfiguration",
+        "metadata": {"name": name},
+        "webhooks": [{
+            "name": f"{name}.example.com",
+            "clientConfig": {"url": url},
+            "failurePolicy": policy,
+            "rules": [{"operations": list(ops), "apiGroups": [""],
+                       "resources": ["pods"]}],
+        }]})
+
+
+class TestWebhookAdmission:
+    def test_validating_webhook_denies(self, api, client):
+        def deny(review):
+            return {"response": {"allowed": False,
+                                 "status": {"message": "no pods today"}}}
+
+        webhooks.register_local_webhook("local://deny", deny)
+        try:
+            _register(client, "Validating", "denier", "local://deny")
+            with pytest.raises(errors.StatusError) as ei:
+                client.pods.create(podspec("p0"))
+            assert ei.value.code == 403
+            assert "no pods today" in str(ei.value)
+        finally:
+            webhooks.unregister_local_webhook("local://deny")
+
+    def test_mutating_webhook_patches(self, api, client):
+        def label_it(review):
+            patch = [{"op": "add", "path": "/metadata/labels",
+                      "value": {"injected": "yes"}}]
+            return {"response": {"allowed": True,
+                                 "patch": base64.b64encode(
+                                     json.dumps(patch).encode()).decode()}}
+
+        webhooks.register_local_webhook("local://mutate", label_it)
+        try:
+            _register(client, "Mutating", "mutator", "local://mutate")
+            client.pods.create(podspec("p1"))
+            got = client.pods.get("p1")
+            assert got["metadata"]["labels"] == {"injected": "yes"}
+        finally:
+            webhooks.unregister_local_webhook("local://mutate")
+
+    def test_failure_policy_ignore_vs_fail(self, api, client):
+        _register(client, "Validating", "broken-ignore",
+                  "http://127.0.0.1:1/x", policy="Ignore")
+        client.pods.create(podspec("p2"))  # unreachable webhook ignored
+        _register(client, "Validating", "broken-fail",
+                  "http://127.0.0.1:1/y", policy="Fail")
+        with pytest.raises(errors.StatusError) as ei:
+            client.pods.create(podspec("p3"))
+        assert ei.value.code == 503
+
+    def test_rules_scope_webhooks(self, api, client):
+        calls = []
+
+        def watcher(review):
+            calls.append(review["request"]["resource"]["resource"])
+            return {"response": {"allowed": True}}
+
+        webhooks.register_local_webhook("local://watch", watcher)
+        try:
+            _register(client, "Validating", "pods-only", "local://watch")
+            client.pods.create(podspec("p4"))
+            client.configmaps.create({"apiVersion": "v1", "kind": "ConfigMap",
+                                      "metadata": {"name": "cm",
+                                                   "namespace": "default"}})
+            assert calls == ["pods"]  # configmap did not match the rules
+        finally:
+            webhooks.unregister_local_webhook("local://watch")
+
+
+class TestAudit:
+    def test_mutations_are_audited_with_outcome(self, api, client):
+        client.pods.create(podspec("a0"))
+        with pytest.raises(errors.StatusError):
+            client.pods.create(podspec("a0"))  # conflict → 409 audited too
+        client.pods.delete("a0", "default")
+        evs = api.audit.events()
+        verbs = [(e["verb"], e["objectRef"]["name"],
+                  e["responseStatus"]["code"]) for e in evs
+                 if e["objectRef"]["resource"] == "pods"]
+        assert ("create", "a0", 201) in verbs
+        assert ("create", "a0", 409) in verbs
+        assert ("delete", "a0", 200) in verbs
+        assert all(e["stage"] == "ResponseComplete" for e in evs)
+
+    def test_reads_are_not_audited(self, api, client):
+        before = len(api.audit.events())
+        client.pods.list("default")
+        assert len(api.audit.events()) == before
+
+
+def test_audit_attributes_authenticated_user(api):
+    """Audit events carry the authenticated username through the gateway
+    (the reference threads user.Info into the audit event the same way)."""
+    from kubernetes_tpu.apiserver.auth import AuthGate, TokenAuthenticator
+    from kubernetes_tpu.apiserver.server import HTTPGateway
+
+    authn = TokenAuthenticator()
+    authn.add("carol-token", "carol")
+    gw = HTTPGateway(api, auth_gate=AuthGate(authn)).start()
+    try:
+        carol = Client.http(gw.url, token="carol-token")
+        carol.pods.create(podspec("authed"))
+        evs = [e for e in api.audit.events()
+               if e["objectRef"]["name"] == "authed"]
+        assert evs and evs[0]["user"]["username"] == "carol"
+    finally:
+        gw.stop()
