@@ -92,10 +92,12 @@ class APIServer:
             obj = self.admission(op, info, obj, old)
         # webhook admission runs AFTER the compiled-in chain (the reference
         # orders MutatingAdmissionWebhook/ValidatingAdmissionWebhook at the
-        # end of the default plugin order); skip for the webhook config
-        # resources themselves to avoid self-administering registrations
+        # end of the default plugin order); webhook-config mutations are not
+        # self-administered and instead invalidate the dispatcher's cache
         if info.group != "admissionregistration.k8s.io":
             obj = self._webhooks.dispatch(op, info, obj, old)
+        else:
+            self._webhooks.invalidate()
         return obj
 
     def close(self) -> None:
@@ -193,12 +195,16 @@ class APIServer:
     def put_scale(self, group: str, resource: str, namespace: str,
                   name: str, scale: Obj) -> Obj:
         replicas = int(scale.get("spec", {}).get("replicas", 0))
+        st_info = self.store(group, resource).info
 
         def apply(obj: Obj) -> Obj:
             if not obj:
                 raise errors.new_not_found(resource, name)
+            old = meta.deep_copy(obj)
             obj.setdefault("spec", {})["replicas"] = replicas
-            return obj
+            # scale writes admit like any other UPDATE (webhooks included)
+            out = self._admit("UPDATE", st_info, obj, old)
+            return out if out is not None else obj
 
         st = self.store(group, resource)
         out = st.storage.guaranteed_update(st.key_for(namespace, name), apply,
@@ -209,9 +215,8 @@ class APIServer:
         """Namespace delete = phase Terminating until spec.finalizers empties
         (registry/core/namespace/storage: Delete + FinalizeREST)."""
         st = self.store("", "namespaces")
-        if self.admission is not None:
-            cur = st.get("", name)
-            self.admission("DELETE", st.info, None, cur)
+        cur = st.get("", name)
+        self._admit("DELETE", st.info, None, cur)  # incl. webhook dispatch
 
         def mark(o: Obj) -> Obj:
             if not o:
@@ -309,6 +314,9 @@ def handle_rest(api: APIServer, method: str, path: str,
 
 def _audit(api: APIServer, method: str, path: str, code: int,
            user: str, body_name: str = "") -> None:
+    # NB: mirrors _handle_rest_inner's path grammar (kept separate because
+    # the router may fail before resolving a store; any change to the
+    # namespaces-subresource exception below must update BOTH sites)
     parts = [p for p in path.split("/") if p]
     ns = name = resource = ""
     try:
@@ -473,9 +481,17 @@ class _Handler(BaseHTTPRequestHandler):
                 return
         try:
             user = ""
-            if auth_gate is not None:
-                user = auth_gate.check(method, parsed.path, query,
-                                       dict(self.headers.items())) or ""
+            try:
+                if auth_gate is not None:
+                    user = auth_gate.check(method, parsed.path, query,
+                                           dict(self.headers.items())) or ""
+            except errors.StatusError as e:
+                # denied requests are audited too (the reference's audit
+                # filter wraps the authorizer for exactly this)
+                if method in _AUDIT_VERBS:
+                    _audit(api, method, parsed.path, e.code, user,
+                           meta.name(body) if isinstance(body, dict) else "")
+                raise
             result = handle_rest(api, method, parsed.path, query, body,
                                  user=user)
         except errors.StatusError as e:

@@ -107,20 +107,30 @@ class WebhookDispatcher:
 
     def __init__(self, api):
         self.api = api
+        # config cache, invalidated by APIServer._admit whenever a webhook
+        # configuration itself is mutated (the watch-fed cached source the
+        # reference uses, without a watcher thread): None = stale
+        self._cache: Dict[str, Optional[List[Obj]]] = {}
+        self._cache_mu = threading.Lock()
+
+    def invalidate(self) -> None:
+        with self._cache_mu:
+            self._cache.clear()
 
     def _configs(self, kind_plural: str) -> List[Obj]:
+        with self._cache_mu:
+            cached = self._cache.get(kind_plural)
+        if cached is not None:
+            return cached
         try:
             store = self.api.store("admissionregistration.k8s.io", kind_plural)
         except errors.StatusError:
             return []  # resource not registered ⇒ genuinely no webhooks
-        # zero-config short-circuit: one O(1) count beats listing + decoding
-        # both config prefixes on every mutation (the reference keeps a
-        # watch-fed cached config source for the same reason)
-        if store.storage.count(store.prefix_for("")) == 0:
-            return []
         # storage failures fail CLOSED: admitting a mutation because the
         # webhook configs could not be read would bypass a Fail-policy hook
         objs, _ = store.storage.list(store.prefix_for(""))
+        with self._cache_mu:
+            self._cache[kind_plural] = objs
         return objs
 
     def dispatch(self, op: str, info, obj: Optional[Obj],
@@ -188,6 +198,7 @@ class AuditLog:
         self._mu = threading.Lock()
         self._ring = deque(maxlen=capacity)
         self._path = path
+        self._file = None  # opened once, lazily (reference log backend)
         self._seq = 0
 
     def record(self, verb: str, resource: str, namespace: str, name: str,
@@ -207,8 +218,10 @@ class AuditLog:
             }
             self._ring.append(ev)
             if self._path:
-                with open(self._path, "a") as f:
-                    f.write(json.dumps(ev) + "\n")
+                if self._file is None:
+                    self._file = open(self._path, "a")
+                self._file.write(json.dumps(ev) + "\n")
+                self._file.flush()
 
     def events(self) -> List[Obj]:
         with self._mu:

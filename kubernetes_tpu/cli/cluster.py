@@ -1,10 +1,19 @@
-"""Cluster bootstrap: kubeadm-init for the TPU-native control plane.
+"""Cluster lifecycle: the kubeadm workflow for the TPU-native control plane.
 
 Analog of `cmd/kubeadm` phases reduced to what a single-process control
-plane needs: bring up storage → apiserver (+HTTP gateway) → scheduler →
-controller-manager → (optionally) hollow nodes, in dependency order, with
-clean teardown. `python -m kubernetes_tpu.cli cluster up` serves until
-interrupted.
+plane needs:
+
+  init  (`up`)    storage → apiserver (+HTTP gateway) → scheduler →
+                  controller-manager → optional hollow nodes, in dependency
+                  order (cmd/kubeadm/app/cmd/init.go phase runner).
+  join  (`join`)  add worker nodes to a RUNNING cluster over its URL —
+                  the kubeadm-join flow with hollow kubelets standing in
+                  for real ones (cmd/kubeadm/app/cmd/join.go).
+  reset (`down`)  tear everything down in reverse order.
+
+A KubeSchedulerConfiguration file/dict flows through `scheduler_config`
+into the scheduler exactly as `--config` does for the reference binary.
+`python -m kubernetes_tpu.cli cluster up` serves until interrupted.
 """
 
 from __future__ import annotations
@@ -32,6 +41,9 @@ class ClusterConfig:
     leader_elect: bool = False
     controllers: Optional[List[str]] = None
     scheduler_name: str = "default-scheduler"
+    # KubeSchedulerConfiguration: a path, YAML/JSON string, or dict
+    # (sched/config.py load_config) — the kube-scheduler --config analog
+    scheduler_config: Optional[object] = None
 
 
 class Cluster:
@@ -47,6 +59,7 @@ class Cluster:
         self.scheduler: Optional[SchedulerServer] = None
         self.manager: Optional[ControllerManager] = None
         self.hollow: Optional[HollowCluster] = None
+        self._joined: List[HollowCluster] = []
 
     # -- phases (kubeadm init workflow) ------------------------------------- #
 
@@ -57,8 +70,10 @@ class Cluster:
                                    port=cfg.port).start()
         self.client = Client.http(self.gateway.url)
         self.scheduler = SchedulerServer(
-            self.client, scheduler_name=cfg.scheduler_name,
-            leader_elect=cfg.leader_elect).start()
+            self.client,
+            scheduler_name=cfg.scheduler_name,
+            leader_elect=cfg.leader_elect,
+            config=cfg.scheduler_config).start()
         self.manager = ControllerManager(
             self.client, controllers=cfg.controllers,
             leader_elect=cfg.leader_elect).start()
@@ -68,7 +83,28 @@ class Cluster:
                 capacity=cfg.hollow_capacity).start()
         return self
 
+    def join(self, n_nodes: int = 1, name_prefix: Optional[str] = None,
+             capacity: Optional[Dict[str, str]] = None) -> "HollowCluster":
+        """kubeadm join: register n worker nodes against the running control
+        plane (a fresh client over the public URL — the same wire path an
+        out-of-process kubelet would take). Each join batch gets a unique
+        default prefix so repeated joins ADD nodes instead of re-registering
+        the previous batch's names."""
+        if self.gateway is None:
+            raise RuntimeError("cluster is not up")
+        if name_prefix is None:
+            name_prefix = f"joined-node-b{len(self._joined)}"
+        extra = HollowCluster(
+            Client.http(self.gateway.url), n_nodes,
+            name_prefix=name_prefix,
+            capacity=capacity or self.config.hollow_capacity).start()
+        self._joined.append(extra)
+        return extra
+
     def down(self) -> None:
+        for extra in reversed(self._joined):
+            extra.stop()
+        self._joined.clear()
         for c in (self.hollow, self.manager, self.scheduler):
             if c is not None:
                 c.stop()
@@ -96,10 +132,13 @@ def cluster_main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--port", type=int, default=6443)
     p.add_argument("--hollow-nodes", type=int, default=0)
     p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--scheduler-config", default=None,
+                   help="KubeSchedulerConfiguration file (YAML/JSON)")
     args = p.parse_args(argv)
     cluster = Cluster(ClusterConfig(port=args.port,
                                     hollow_nodes=args.hollow_nodes,
-                                    leader_elect=args.leader_elect)).up()
+                                    leader_elect=args.leader_elect,
+                                    scheduler_config=args.scheduler_config)).up()
     print(f"control plane ready at {cluster.url} "
           f"({args.hollow_nodes} hollow nodes)")
     try:

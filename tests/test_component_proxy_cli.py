@@ -382,3 +382,44 @@ class TestReviewRegressions:
             api.evict_pod("default", "m1", {})
         assert ei.value.code == 500
         assert "more than one" in ei.value.message
+
+
+class TestClusterLifecycle:
+    """kubeadm init/join/reset workflow (cmd/kubeadm/app/cmd/{init,join}.go)."""
+
+    def test_join_adds_schedulable_nodes_and_config_flows(self):
+        import time as _t
+
+        from kubernetes_tpu.cli.cluster import Cluster, ClusterConfig
+
+        cfg = ClusterConfig(hollow_nodes=1, scheduler_config={
+            "kind": "KubeSchedulerConfiguration",
+            "schedulerName": "default-scheduler",
+            "podInitialBackoffSeconds": 2,
+        })
+        with Cluster(cfg) as cluster:
+            # --config flowed into the live scheduler
+            assert cluster.scheduler.scheduler.queue.initial_backoff == 2
+            client = cluster.client
+            deadline = _t.time() + 10
+            while _t.time() < deadline and \
+                    len(client.nodes.list()["items"]) < 1:
+                _t.sleep(0.1)
+            cluster.join(2)
+            deadline = _t.time() + 10
+            while _t.time() < deadline and \
+                    len(client.nodes.list()["items"]) < 3:
+                _t.sleep(0.1)
+            names = {n["metadata"]["name"]
+                     for n in client.nodes.list()["items"]}
+            assert sum(1 for n in names if n.startswith("joined-node")) == 2
+            # a pod schedules onto the enlarged cluster
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "joined-pod", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "i"}]}})
+            deadline = _t.time() + 15
+            while _t.time() < deadline and not client.pods.get(
+                    "joined-pod").get("spec", {}).get("nodeName"):
+                _t.sleep(0.1)
+            assert client.pods.get("joined-pod")["spec"].get("nodeName")
