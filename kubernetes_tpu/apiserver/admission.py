@@ -33,11 +33,21 @@ EVICT = "EVICT"
 
 
 class AdmissionPlugin:
+    """Two-phase plugin, mirroring the reference's MutationInterface /
+    ValidationInterface split (apiserver/pkg/admission/interfaces.go):
+    `admit` may mutate; `validate` may only reject. The server runs all
+    mutators (built-in, then mutating webhooks) before any validator, so
+    validators always see the final patched object."""
+
     name = "plugin"
 
     def admit(self, api, op: str, info: ResourceInfo, obj: Optional[Obj],
               old: Optional[Obj]) -> Optional[Obj]:
         return obj
+
+    def validate(self, api, op: str, info: ResourceInfo, obj: Optional[Obj],
+                 old: Optional[Obj]) -> None:
+        return None
 
 
 class AdmissionChain:
@@ -51,12 +61,23 @@ class AdmissionChain:
         self.api = api
         return self
 
-    def __call__(self, op: str, info: ResourceInfo, obj: Optional[Obj],
-                 old: Optional[Obj]) -> Optional[Obj]:
+    def mutate(self, op: str, info: ResourceInfo, obj: Optional[Obj],
+               old: Optional[Obj]) -> Optional[Obj]:
         for p in self.plugins:
             out = p.admit(self.api, op, info, obj, old)
             if out is not None:
                 obj = out
+        return obj
+
+    def validate(self, op: str, info: ResourceInfo, obj: Optional[Obj],
+                 old: Optional[Obj]) -> None:
+        for p in self.plugins:
+            p.validate(self.api, op, info, obj, old)
+
+    def __call__(self, op: str, info: ResourceInfo, obj: Optional[Obj],
+                 old: Optional[Obj]) -> Optional[Obj]:
+        obj = self.mutate(op, info, obj, old)
+        self.validate(op, info, obj, old)
         return obj
 
 
@@ -72,16 +93,18 @@ class NamespaceLifecycle(AdmissionPlugin):
     name = "NamespaceLifecycle"
     PROTECTED = ("default", "kube-system", "kube-public")
 
-    def admit(self, api, op, info, obj, old):
+    def validate(self, api, op, info, obj, old):
+        # pure validator in the reference too (lifecycle implements only
+        # ValidationInterface) — runs after all mutation, webhooks included
         if info.resource == "namespaces":
             if op == DELETE and old is not None and \
                     meta.name(old) in self.PROTECTED:
                 raise errors.new_forbidden(
                     "namespaces", meta.name(old),
                     "this namespace may not be deleted")
-            return obj
+            return
         if op != CREATE or not info.namespaced or obj is None:
-            return obj
+            return
         ns = meta.namespace(obj) or "default"
         try:
             ns_obj = api.store("", "namespaces").get("", ns)
@@ -95,7 +118,6 @@ class NamespaceLifecycle(AdmissionPlugin):
                 info.resource, meta.name(obj),
                 f'unable to create new content in namespace {ns} because '
                 f'it is being terminated')
-        return obj
 
 
 class PriorityAdmission(AdmissionPlugin):
@@ -178,39 +200,49 @@ class ServiceAccountAdmission(AdmissionPlugin):
 
 
 class LimitRanger(AdmissionPlugin):
-    """Apply LimitRange container defaults + max checks
-    (limitranger/admission.go, Container type only)."""
+    """Apply LimitRange container defaults (mutate phase) + max checks
+    (validate phase — re-run on the final object so a mutating webhook that
+    inflates requests cannot dodge the limit; limitranger/admission.go
+    implements both interfaces the same way)."""
 
     name = "LimitRanger"
 
-    def admit(self, api, op, info, obj, old):
-        if info.resource != "pods" or op != CREATE or obj is None:
-            return obj
-        ns = meta.namespace(obj) or "default"
+    def _limits(self, api, ns: str):
         store = api.store("", "limitranges")
         try:
             items, _ = store.storage.list(store.prefix_for(ns))
         except errors.StatusError:
-            return obj
+            return
         for lr in items:
             for limit in lr.get("spec", {}).get("limits", []) or []:
-                if limit.get("type", "Container") != "Container":
-                    continue
-                defaults = limit.get("defaultRequest") or {}
-                maxes = limit.get("max") or {}
-                for c in obj.get("spec", {}).get("containers", []) or []:
-                    res = c.setdefault("resources", {})
-                    reqs = res.setdefault("requests", {})
-                    for k, v in defaults.items():
-                        reqs.setdefault(k, v)
-                    for k, vmax in maxes.items():
-                        v = reqs.get(k)
-                        if v is not None and mq.cmp(v, vmax) > 0:
-                            raise errors.new_forbidden(
-                                "pods", meta.name(obj),
-                                f"maximum {k} usage per Container is "
-                                f"{vmax}, but request is {v}")
+                if limit.get("type", "Container") == "Container":
+                    yield limit
+
+    def admit(self, api, op, info, obj, old):
+        if info.resource != "pods" or op != CREATE or obj is None:
+            return obj
+        for limit in self._limits(api, meta.namespace(obj) or "default"):
+            defaults = limit.get("defaultRequest") or {}
+            for c in obj.get("spec", {}).get("containers", []) or []:
+                reqs = c.setdefault("resources", {}).setdefault("requests", {})
+                for k, v in defaults.items():
+                    reqs.setdefault(k, v)
         return obj
+
+    def validate(self, api, op, info, obj, old):
+        if info.resource != "pods" or op != CREATE or obj is None:
+            return
+        for limit in self._limits(api, meta.namespace(obj) or "default"):
+            maxes = limit.get("max") or {}
+            for c in obj.get("spec", {}).get("containers", []) or []:
+                reqs = (c.get("resources", {}) or {}).get("requests") or {}
+                for k, vmax in maxes.items():
+                    v = reqs.get(k)
+                    if v is not None and mq.cmp(v, vmax) > 0:
+                        raise errors.new_forbidden(
+                            "pods", meta.name(obj),
+                            f"maximum {k} usage per Container is "
+                            f"{vmax}, but request is {v}")
 
 
 class ResourceQuotaAdmission(AdmissionPlugin):
@@ -219,7 +251,12 @@ class ResourceQuotaAdmission(AdmissionPlugin):
     accessor's CAS update): the check and the usage bump happen inside one
     guaranteed_update, so concurrent creates cannot jointly exceed the hard
     limit. The quota controller recomputes true usage on its resync (which
-    also releases reservations for creates that later failed)."""
+    also releases reservations for creates that later failed).
+
+    Runs in the VALIDATE phase (the reference registers ResourceQuota as a
+    validating plugin, last in the order): the reservation is computed from
+    the final object, after mutating webhooks — a webhook inflating
+    spec.resources cannot bypass quota."""
 
     name = "ResourceQuota"
 
@@ -232,7 +269,7 @@ class ResourceQuotaAdmission(AdmissionPlugin):
                 total = total + mq.parse(v)
         return total
 
-    def admit(self, api, op, info, obj, old):
+    def validate(self, api, op, info, obj, old):
         if info.resource != "pods" or op != CREATE or obj is None:
             return obj
         ns = meta.namespace(obj) or "default"
@@ -313,11 +350,12 @@ def credit_pdb_disruption(api, pod: Obj) -> None:
 
 class EvictionPDBGate(AdmissionPlugin):
     """Evictions respect PodDisruptionBudgets: 0 allowed disruptions →
-    429 TooManyRequests (eviction.go checkAndDecrement)."""
+    429 TooManyRequests (eviction.go checkAndDecrement). Validate-phase:
+    the decrement is a gate, not a mutation of the admitted object."""
 
     name = "EvictionPDBGate"
 
-    def admit(self, api, op, info, obj, old):
+    def validate(self, api, op, info, obj, old):
         if op != EVICT or old is None:
             return obj
         ns = meta.namespace(old) or "default"

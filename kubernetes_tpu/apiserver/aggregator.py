@@ -30,6 +30,14 @@ Obj = Dict[str, Any]
 _LOCAL_BACKENDS: Dict[str, Callable] = {}
 
 
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        return None  # surface the 3xx as an HTTPError → returned verbatim
+
+
+_NO_REDIRECT_OPENER = urllib.request.build_opener(_NoRedirect)
+
+
 def register_local_backend(name: str, handler: Callable) -> None:
     _LOCAL_BACKENDS[name] = handler
 
@@ -72,8 +80,17 @@ def proxy(api, apiservice: Obj, method: str, path: str,
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(url, data=data, method=method, headers={
         "Content-Type": "application/json"})
+    # per-APIService timeout (the reference's proxy transport dial timeout);
+    # redirects are NOT followed — the reference's proxyHandler returns the
+    # backend's 3xx to the caller rather than re-issuing the (possibly
+    # body-carrying) request to an attacker-chosen Location
     try:
-        with urllib.request.urlopen(req, timeout=10) as resp:
+        timeout = float((apiservice.get("spec", {}) or {})
+                        .get("timeoutSeconds") or 10)
+    except (TypeError, ValueError):
+        timeout = 10.0
+    try:
+        with _NO_REDIRECT_OPENER.open(req, timeout=timeout) as resp:
             payload = resp.read()
             code = resp.status
     except urllib.error.HTTPError as e:

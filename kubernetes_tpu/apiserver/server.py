@@ -88,19 +88,32 @@ class APIServer:
 
     def _admit(self, op: str, info: ResourceInfo, obj: Optional[Obj],
                old: Optional[Obj]) -> Optional[Obj]:
-        if self.admission is not None:
-            obj = self.admission(op, info, obj, old)
-        # webhook admission runs AFTER the compiled-in chain (the reference
-        # orders MutatingAdmissionWebhook/ValidatingAdmissionWebhook at the
-        # end of the default plugin order); webhook-config mutations are not
-        # self-administered and instead invalidate the dispatcher's cache
+        # Reference ordering (options/plugins.go: MutatingAdmissionWebhook
+        # sits after the built-in mutators, ValidatingAdmissionWebhook after
+        # the built-in validators): built-in mutate → mutating webhooks →
+        # built-in validate → validating webhooks. Validators therefore see
+        # the webhook-patched object — a mutating webhook cannot dodge quota
+        # or LimitRange maxima. Webhook-config mutations are not
+        # self-administered and instead invalidate the dispatcher's cache.
+        adm = self.admission
+        phased = hasattr(adm, "mutate") and hasattr(adm, "validate")
+        if adm is not None:
+            obj = adm.mutate(op, info, obj, old) if phased \
+                else adm(op, info, obj, old)
         if info.group != "admissionregistration.k8s.io":
-            obj = self._webhooks.dispatch(op, info, obj, old)
+            obj = self._webhooks.dispatch(op, info, obj, old,
+                                          phase="mutating")
+            if phased:
+                adm.validate(op, info, obj, old)
+            self._webhooks.dispatch(op, info, obj, old, phase="validating")
         else:
+            if phased:
+                adm.validate(op, info, obj, old)
             self._webhooks.invalidate()
         return obj
 
     def close(self) -> None:
+        self.audit.close()
         self.storage.close()
 
     # ------------------------------------------------------------------ #

@@ -7,7 +7,7 @@ Webhook admission ⇔ plugin/pkg/admission/webhook/{mutating,validating}:
 register webhooks with resource rules; matching requests POST an
 AdmissionReview to the webhook and apply its AdmissionResponse (patches for
 mutating, allow/deny for both). As with the aggregation layer
-(docs/PARITY.md #12), backends are addressed by `url` in clientConfig (or an
+(docs/PARITY.md #13), backends are addressed by `url` in clientConfig (or an
 in-process handler for tests) — there is no cluster network to resolve a
 service reference through. failurePolicy Ignore/Fail is honored.
 
@@ -48,8 +48,49 @@ def _rule_matches(rule: Obj, op: str, info) -> bool:
     groups = rule.get("apiGroups", ["*"])
     if "*" not in groups and info.group not in groups:
         return False
+    versions = rule.get("apiVersions", ["*"])
+    if "*" not in versions and info.version not in versions:
+        return False
+    scope = rule.get("scope", "*")
+    if scope == "Namespaced" and not info.namespaced:
+        return False
+    if scope == "Cluster" and info.namespaced:
+        return False
     resources = rule.get("resources", ["*"])
     return "*" in resources or info.resource in resources
+
+
+def _webhook_selectors_match(api, wh: Obj, info, obj: Optional[Obj],
+                             old: Optional[Obj]) -> bool:
+    """namespaceSelector / objectSelector gating
+    (webhook/rules + webhook/object matchers in the reference). matchPolicy
+    is a no-op here — one served version per resource (docs/PARITY.md #14)."""
+    from kubernetes_tpu.machinery import labels as mlabels
+
+    osel = wh.get("objectSelector")
+    if osel:
+        sel = mlabels.from_label_selector(osel)
+        if not (sel.matches(meta.labels_of(obj or {})) or
+                (old is not None and sel.matches(meta.labels_of(old)))):
+            return False
+    nsel = wh.get("namespaceSelector")
+    if nsel:
+        if info.resource == "namespaces":
+            # operations on a Namespace itself match against its own labels
+            # (webhook/predicates/namespace/matcher.go GetNamespaceLabels)
+            ns_obj = obj or old or {}
+        elif info.namespaced:
+            ns = meta.namespace(obj or old or {}) or "default"
+            try:
+                ns_obj = api.store("", "namespaces").get("", ns)
+            except errors.StatusError:
+                ns_obj = {}
+        else:
+            return True  # cluster-scoped: namespaceSelector never excludes
+        if not mlabels.from_label_selector(nsel).matches(
+                meta.labels_of(ns_obj)):
+            return False
+    return True
 
 
 def _call_webhook(cfg_url: str, review: Obj, timeout: float) -> Obj:
@@ -134,14 +175,22 @@ class WebhookDispatcher:
         return objs
 
     def dispatch(self, op: str, info, obj: Optional[Obj],
-                 old: Optional[Obj]) -> Optional[Obj]:
-        for phase, plural in (("mutating", "mutatingwebhookconfigurations"),
-                              ("validating",
-                               "validatingwebhookconfigurations")):
+                 old: Optional[Obj],
+                 phase: Optional[str] = None) -> Optional[Obj]:
+        """phase='mutating'|'validating' runs one tier (the server interleaves
+        built-in validators between them); None runs both in order."""
+        tiers = (("mutating", "mutatingwebhookconfigurations"),
+                 ("validating", "validatingwebhookconfigurations"))
+        if phase is not None:
+            tiers = tuple(t for t in tiers if t[0] == phase)
+        for phase, plural in tiers:
             for cfg in self._configs(plural):
                 for wh in cfg.get("webhooks", []) or []:
                     if not any(_rule_matches(r, op, info)
                                for r in wh.get("rules", []) or []):
+                        continue
+                    if not _webhook_selectors_match(self.api, wh, info,
+                                                    obj, old):
                         continue
                     url = (wh.get("clientConfig", {}) or {}).get("url", "")
                     policy = wh.get("failurePolicy", "Fail")
@@ -195,33 +244,59 @@ class AuditLog:
     (and optionally a JSONL file)."""
 
     def __init__(self, capacity: int = 4096, path: Optional[str] = None):
-        self._mu = threading.Lock()
+        self._mu = threading.Lock()        # guards ring + seq + pending
+        self._io_mu = threading.Lock()     # serializes file writers only
         self._ring = deque(maxlen=capacity)
+        self._pending: List[Obj] = []      # events not yet on disk
         self._path = path
         self._file = None  # opened once, lazily (reference log backend)
+        self._closed = False
         self._seq = 0
 
     def record(self, verb: str, resource: str, namespace: str, name: str,
                code: int, user: str = "") -> None:
+        ev = {
+            "kind": "Event", "apiVersion": "audit.k8s.io/v1",
+            "stage": "ResponseComplete",
+            "verb": verb, "user": {"username": user or "system:unknown"},
+            "objectRef": {"resource": resource, "namespace": namespace,
+                          "name": name},
+            "responseStatus": {"code": code},
+            "stageTimestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()),
+        }
         with self._mu:
             self._seq += 1
-            ev = {
-                "kind": "Event", "apiVersion": "audit.k8s.io/v1",
-                "auditID": f"audit-{self._seq}",
-                "stage": "ResponseComplete",
-                "verb": verb, "user": {"username": user or "system:unknown"},
-                "objectRef": {"resource": resource, "namespace": namespace,
-                              "name": name},
-                "responseStatus": {"code": code},
-                "stageTimestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                                time.gmtime()),
-            }
+            ev["auditID"] = f"audit-{self._seq}"
             self._ring.append(ev)
             if self._path:
-                if self._file is None:
-                    self._file = open(self._path, "a")
-                self._file.write(json.dumps(ev) + "\n")
-                self._file.flush()
+                self._pending.append(ev)
+        if self._path:
+            self._flush()
+
+    def _flush(self) -> None:
+        """Drain pending events to the JSONL file OUTSIDE the record mutex:
+        a slow disk batches behind one writer instead of serializing every
+        REST mutation (the reference's log backend is likewise an async
+        batching sink)."""
+        with self._io_mu:
+            with self._mu:
+                batch, self._pending = self._pending, []
+            if not batch or self._closed:
+                return  # post-close records stay in the ring only
+            if self._file is None:
+                self._file = open(self._path, "a")
+            self._file.write("".join(json.dumps(e) + "\n" for e in batch))
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._path:
+            self._flush()
+        with self._io_mu:
+            self._closed = True
+            if self._file is not None:
+                self._file.close()
+                self._file = None
 
     def events(self) -> List[Obj]:
         with self._mu:

@@ -108,6 +108,139 @@ class TestWebhookAdmission:
             webhooks.unregister_local_webhook("local://watch")
 
 
+class TestAdmissionOrdering:
+    def test_mutating_webhook_cannot_bypass_quota(self, api, client):
+        """Built-in validators run AFTER mutating webhooks (reference plugin
+        order: MutatingAdmissionWebhook precedes the validating tier), so a
+        webhook that inflates spec.resources is still quota-checked."""
+        client.resource("", "v1", "resourcequotas").create({
+            "apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": {"name": "rq", "namespace": "default"},
+            "spec": {"hard": {"requests.cpu": "1"}}})
+
+        def inflate(review):
+            patch = [{"op": "replace",
+                      "path": "/spec/containers/0/resources",
+                      "value": {"requests": {"cpu": "64"}}}]
+            return {"response": {"allowed": True,
+                                 "patch": base64.b64encode(
+                                     json.dumps(patch).encode()).decode()}}
+
+        webhooks.register_local_webhook("local://inflate", inflate)
+        try:
+            _register(client, "Mutating", "inflater", "local://inflate")
+            with pytest.raises(errors.StatusError) as ei:
+                client.pods.create(podspec("greedy"))
+            assert ei.value.code == 403
+            assert "exceeded quota" in str(ei.value)
+        finally:
+            webhooks.unregister_local_webhook("local://inflate")
+
+    def test_mutating_webhook_cannot_bypass_limitrange_max(self, api, client):
+        client.resource("", "v1", "limitranges").create({
+            "apiVersion": "v1", "kind": "LimitRange",
+            "metadata": {"name": "lr", "namespace": "default"},
+            "spec": {"limits": [{"type": "Container",
+                                 "max": {"cpu": "2"}}]}})
+
+        def inflate(review):
+            patch = [{"op": "replace",
+                      "path": "/spec/containers/0/resources",
+                      "value": {"requests": {"cpu": "100"}}}]
+            return {"response": {"allowed": True,
+                                 "patch": base64.b64encode(
+                                     json.dumps(patch).encode()).decode()}}
+
+        webhooks.register_local_webhook("local://inflate2", inflate)
+        try:
+            _register(client, "Mutating", "inflater2", "local://inflate2")
+            with pytest.raises(errors.StatusError) as ei:
+                client.pods.create(podspec("greedy2"))
+            assert "maximum cpu usage" in str(ei.value)
+        finally:
+            webhooks.unregister_local_webhook("local://inflate2")
+
+
+class TestWebhookSelectors:
+    def test_namespace_selector_scopes_webhook(self, api, client):
+        client.resource("", "v1", "namespaces").create({
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "prod", "labels": {"env": "prod"}}})
+        calls = []
+
+        def watcher(review):
+            calls.append(review["request"]["namespace"])
+            return {"response": {"allowed": True}}
+
+        webhooks.register_local_webhook("local://nsel", watcher)
+        try:
+            plural = "validatingwebhookconfigurations"
+            client.resource("admissionregistration.k8s.io", "v1", plural,
+                            namespaced=False).create({
+                "apiVersion": "admissionregistration.k8s.io/v1",
+                "kind": "ValidatingWebhookConfiguration",
+                "metadata": {"name": "ns-scoped"},
+                "webhooks": [{
+                    "name": "ns.example.com",
+                    "clientConfig": {"url": "local://nsel"},
+                    "namespaceSelector": {"matchLabels": {"env": "prod"}},
+                    "rules": [{"operations": ["CREATE"], "apiGroups": [""],
+                               "resources": ["pods"]}]}]})
+            client.pods.create(podspec("in-default"))          # not matched
+            client.pods.create(podspec("in-prod", ns="prod"))  # matched
+            assert calls == ["prod"]
+        finally:
+            webhooks.unregister_local_webhook("local://nsel")
+
+    def test_object_selector_scopes_webhook(self, api, client):
+        calls = []
+
+        def watcher(review):
+            calls.append(review["request"]["name"])
+            return {"response": {"allowed": True}}
+
+        webhooks.register_local_webhook("local://osel", watcher)
+        try:
+            plural = "validatingwebhookconfigurations"
+            client.resource("admissionregistration.k8s.io", "v1", plural,
+                            namespaced=False).create({
+                "apiVersion": "admissionregistration.k8s.io/v1",
+                "kind": "ValidatingWebhookConfiguration",
+                "metadata": {"name": "obj-scoped"},
+                "webhooks": [{
+                    "name": "obj.example.com",
+                    "clientConfig": {"url": "local://osel"},
+                    "objectSelector": {"matchLabels": {"hooked": "yes"}},
+                    "rules": [{"operations": ["CREATE"], "apiGroups": [""],
+                               "resources": ["pods"]}]}]})
+            client.pods.create(podspec("plain"))
+            spec = podspec("labeled")
+            spec["metadata"]["labels"] = {"hooked": "yes"}
+            client.pods.create(spec)
+            assert calls == ["labeled"]
+        finally:
+            webhooks.unregister_local_webhook("local://osel")
+
+
+def test_audit_file_backend_flushes_and_closes(tmp_path):
+    """KTPU_AUDIT_LOG file sink: events land as JSONL, writes happen outside
+    the record mutex, and APIServer.close() closes the handle."""
+    import os
+
+    path = str(tmp_path / "audit.jsonl")
+    os.environ["KTPU_AUDIT_LOG"] = path
+    try:
+        api = APIServer()
+        Client.local(api).pods.create(podspec("audited"))
+        api.close()
+    finally:
+        os.environ.pop("KTPU_AUDIT_LOG", None)
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert any(e["verb"] == "create" and e["objectRef"]["name"] == "audited"
+               for e in lines)
+    assert api.audit._file is None  # handle released by close()
+
+
 class TestAudit:
     def test_mutations_are_audited_with_outcome(self, api, client):
         client.pods.create(podspec("a0"))
