@@ -58,6 +58,7 @@ DEFAULT_STAGES = [
     (2000, 20000, "flagship"),
     (5000, 50000, "flagship"),
     (5000, 50000, "density"),
+    (5000, 100000, "gang"),
 ]
 
 
@@ -137,7 +138,7 @@ def _stage_main(n_nodes, n_pods, kind):
     import jax
 
     from kubernetes_tpu.models.workloads import (
-        density_pods, flagship_pods, make_nodes)
+        density_pods, flagship_pods, gang_workload_pods, make_nodes)
     from kubernetes_tpu.sched.cycle import (
         _schedule_batch, snapshot_with_keys)
     from kubernetes_tpu.state.cache import SchedulerCache
@@ -145,8 +146,8 @@ def _stage_main(n_nodes, n_pods, kind):
     from kubernetes_tpu.state.encode import Encoder
 
     nodes = make_nodes(n_nodes)
-    pods = (flagship_pods(n_pods) if kind == "flagship"
-            else density_pods(n_pods))
+    pods = {"flagship": flagship_pods, "density": density_pods,
+            "gang": gang_workload_pods}[kind](n_pods)
     base = Dims(N=n_nodes, P=n_pods, E=1)
 
     cache = SchedulerCache()
@@ -168,7 +169,8 @@ def _stage_main(n_nodes, n_pods, kind):
     # one-time compile + first run
     t0 = time.perf_counter()
     res = _schedule_batch(snap.tables, snap.pending, keys, snap.dims.D,
-                          snap.existing, has_node_name=snap.dims.has_node_name)
+                          snap.existing, has_node_name=snap.dims.has_node_name,
+                          gang=snap.gang)
     jax.device_get(res.node)
     t_warm = time.perf_counter() - t0
 
@@ -178,7 +180,7 @@ def _stage_main(n_nodes, n_pods, kind):
         s, k = snapshot_with_keys(cache, enc, pending, base)
         t_snap = time.perf_counter() - t0
         r = _schedule_batch(s.tables, s.pending, k, s.dims.D, s.existing,
-                            has_node_name=s.dims.has_node_name)
+                            has_node_name=s.dims.has_node_name, gang=s.gang)
         node_idx = jax.device_get(r.node)
         placements = [s.node_order[i] if i >= 0 else None
                       for i in node_idx[: len(pending)]]

@@ -347,10 +347,44 @@ class Pod:
     node_name: str = ""  # spec.nodeName — set once bound
     scheduler_name: str = DEFAULT_SCHEDULER_NAME
     creation_index: int = 0  # monotonic stand-in for creationTimestamp ordering
+    # Gang/co-scheduling (BASELINE config 5). The reference has no in-tree
+    # equivalent; the semantics follow the sig-scheduling coscheduling
+    # protocol: pods carry their group name (label/annotation
+    # `pod-group.scheduling.sigs.k8s.io/name`) and the group's minimum
+    # member count (`.../min-available`, or a PodGroup object's
+    # spec.minMember). A group commits all-or-nothing per cycle: either
+    # ≥ min_member members (counting already-bound members) place, or none.
+    pod_group: str = ""   # namespaced group name; "" = not gang-scheduled
+    min_member: int = 0   # group minMember hint carried on the pod
 
     def __post_init__(self) -> None:
         if not self.uid:
             self.uid = f"{self.namespace}/{self.name}"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def group_key(self) -> str:
+        """Namespaced gang-group key ('' when ungrouped) — the ONE
+        normalization site (encoder, cache accounting, and the Coscheduling
+        plugin all key groups by this)."""
+        if not self.pod_group:
+            return ""
+        return self.pod_group if "/" in self.pod_group \
+            else f"{self.namespace}/{self.pod_group}"
+
+
+@dataclass
+class PodGroup:
+    """A gang-scheduling pod group (coscheduling PodGroup CRD analog,
+    scheduling.sigs.k8s.io/v1alpha1): all-or-nothing admission with
+    spec.minMember. Members reference it via Pod.pod_group = "{ns}/{name}"."""
+
+    name: str
+    namespace: str = "default"
+    min_member: int = 1
 
     @property
     def key(self) -> str:

@@ -244,11 +244,29 @@ def pod_from_v1(obj: Dict[str, Any]) -> Pod:
         for t in spec.get("topologySpreadConstraints") or []
     )
 
+    # gang scheduling: the coscheduling protocol's pod-carried group
+    # reference (label or annotation pod-group.scheduling.sigs.k8s.io/name
+    # + .../min-available); no in-tree reference equivalent (BASELINE #5).
+    # Label wins over annotation for BOTH keys, so a single source supplies
+    # a consistent (name, min) pair.
+    labels = dict(meta.get("labels") or {})
+    anns = dict(meta.get("annotations") or {})
+
+    def _gang(key):
+        full = f"pod-group.scheduling.sigs.k8s.io/{key}"
+        return labels.get(full, "") or anns.get(full, "")
+
+    group = _gang("name")
+    try:
+        min_member = int(_gang("min-available") or 0)
+    except (TypeError, ValueError):
+        min_member = 0
+
     return Pod(
         name=meta.get("name", ""),
         namespace=meta.get("namespace", "default") or "default",
         uid=meta.get("uid", "") or "",
-        labels=dict(meta.get("labels") or {}),
+        labels=labels,
         requests=pod_request_from_spec(spec),
         node_selector=dict(spec.get("nodeSelector") or {}),
         affinity=affinity_from_spec(spec),
@@ -258,6 +276,8 @@ def pod_from_v1(obj: Dict[str, Any]) -> Pod:
         priority=int(spec.get("priority", 0) or 0),
         node_name=spec.get("nodeName", "") or "",
         scheduler_name=spec.get("schedulerName", DEFAULT_SCHEDULER_NAME) or DEFAULT_SCHEDULER_NAME,
+        pod_group=group,
+        min_member=min_member,
     )
 
 
@@ -351,11 +371,16 @@ def pod_to_v1(pod: Pod) -> Dict[str, Any]:
              "labelSelector": _selector_to_v1(c.selector)}
             for c in pod.topology_spread
         ]
-    return {
-        "metadata": {"name": pod.name, "namespace": pod.namespace, "uid": pod.uid,
-                     "labels": dict(pod.labels)},
-        "spec": spec,
-    }
+    md: Dict[str, Any] = {"name": pod.name, "namespace": pod.namespace,
+                          "uid": pod.uid, "labels": dict(pod.labels)}
+    if pod.pod_group:
+        anns: Dict[str, Any] = {
+            "pod-group.scheduling.sigs.k8s.io/name": pod.pod_group}
+        if pod.min_member:
+            anns["pod-group.scheduling.sigs.k8s.io/min-available"] = \
+                str(pod.min_member)
+        md["annotations"] = anns
+    return {"metadata": md, "spec": spec}
 
 
 def _selector_to_v1(sel: LabelSelector) -> Dict[str, Any]:

@@ -352,6 +352,84 @@ FUSED_SCORE_PLUGINS = frozenset({
 })
 
 
+class Coscheduling(Plugin):
+    """Gang scheduling on the Permit machinery — the host per-pod analog of
+    the device gang engine (ops/gang.py). Semantics follow the out-of-tree
+    sig-scheduling coscheduling plugin (the reference ships none in-tree:
+    the Permit wait/allow surface at framework/v1alpha1/interface.go:339 +
+    waiting_pods_map.go IS its extension hook for exactly this):
+
+      * Reserve tracks a group's assumed members;
+      * Permit WAITs each member (with `timeout`) until the group's
+        minMember count is reserved, then the arriving member ALLOWs every
+        waiting sibling (allow_waiting_pod) and proceeds itself;
+      * the waiting-map timeout rejecting a parked member unreserves it —
+        a group that never fills releases everything it held.
+
+    Wiring: the Scheduler auto-wires `on_release` (its complete_waiting) and
+    `bound_count` (its cache's group_bound_count) when this plugin is in the
+    permit set — see Scheduler.__init__; tests exercising the framework
+    standalone can leave both unset and quorum falls back to the plugin's
+    own reservation ledger."""
+
+    name = "Coscheduling"
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+        self.handle = None        # Framework runtime (allow_waiting_pod)
+        self.on_release = None    # Scheduler.complete_waiting
+        self.groups: dict = {}    # group key → authoritative minMember
+        self.bound_count = None   # callable: group key → assumed+bound members
+        self._reserved: dict = {}  # group key → in-flight reserved pod keys
+
+    def register_group(self, key: str, min_member: int) -> None:
+        """PodGroup object registration (overrides pod-carried hints)."""
+        self.groups[key] = int(min_member)
+
+    def _min_member(self, gk: str, pod) -> int:
+        return self.groups.get(gk) or max(pod.min_member, 1)
+
+    def reserve(self, state, pod, node_name):
+        gk = pod.group_key
+        if gk:
+            self._reserved.setdefault(gk, set()).add(pod.key)
+        return None
+
+    def unreserve(self, state, pod, node_name):
+        gk = pod.group_key
+        if gk:
+            self._reserved.get(gk, set()).discard(pod.key)
+
+    def permit(self, state, pod, node_name):
+        from .interface import Code, Status
+
+        gk = pod.group_key
+        if not gk:
+            return None, 0.0
+        # quorum: members assumed in the cache (covers every reserved member
+        # — assume precedes Reserve — PLUS members bound in earlier cycles,
+        # and self-heals when group pods are deleted). The plugin's own
+        # ledger is the fallback for cache-less standalone use.
+        if self.bound_count is not None:
+            have = int(self.bound_count(gk))
+        else:
+            have = len(self._reserved.get(gk, ()))
+        if have >= self._min_member(gk, pod):
+            # quorum reached: release every waiting sibling, admit this one,
+            # and retire the group's in-flight ledger (released members are
+            # bound from here on — bound_count keeps counting them)
+            waiting = [k for k in self._reserved.pop(gk, ()) if k != pod.key]
+            if self.handle is not None:
+                for key in waiting:
+                    if self.handle.allow_waiting_pod(key, self.name) and \
+                            self.on_release is not None:
+                        self.on_release(key)
+            return None, 0.0
+        return Status(Code.WAIT, f"gang {gk}: {have}/"
+                      f"{self._min_member(gk, pod)} members reserved"), \
+            self.timeout
+
+
 def extra_score_plugins(framework) -> tuple:
     """(plugin, weight) pairs for configured score plugins OUTSIDE the fused
     set — NodeLabel, RequestedToCapacityRatio, ResourceLimits,
@@ -402,6 +480,8 @@ def default_registry() -> Registry:
         "RequestedToCapacityRatio": lambda cfg: RequestedToCapacityRatio(
             shape=(cfg or {}).get("shape", ((0, 100), (100, 0)))),
         "NodeResourcesResourceLimits": lambda cfg: ResourceLimits(),
+        "Coscheduling": lambda cfg: Coscheduling(
+            timeout=float((cfg or {}).get("permitWaitingTimeSeconds", 30.0))),
     }
 
 

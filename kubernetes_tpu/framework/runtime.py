@@ -150,6 +150,13 @@ class Framework:
                 raise ValueError(f"score plugin {p.name} has non-positive weight {w}")
             p.weight = w
 
+        # plugins declaring a `handle` slot get the framework itself — the
+        # FrameworkHandle injection (framework.go:145 NewFramework passes the
+        # handle to every factory; Coscheduling uses it to allow waiters)
+        for p in instances.values():
+            if hasattr(p, "handle") and p.handle is None:
+                p.handle = self
+
         self._waiting: Dict[str, _WaitingPod] = {}
         self._wmu = threading.Lock()
 

@@ -74,6 +74,39 @@ def density_pods(n: int, groups: int = 50, seed: int = 0) -> List[Pod]:
     return pods
 
 
+def gang_workload_pods(n: int, seed: int = 0) -> List[Pod]:
+    """Config-5 workload (BASELINE.md row 5): all-or-nothing ML jobs at
+    5k nodes × 100k pods. Jobs cycle through gang sizes {8, 16, 32, 64} with
+    minMember == size (classic data-parallel training: the job runs only at
+    full world size); ~2% of jobs are 'monsters' whose per-member request
+    exceeds any node (statically infeasible — they exercise the gang
+    engine's bulk-rejection path, the analog of a Permit timeout storm).
+    Deterministic by construction."""
+    sizes = (8, 16, 32, 64)
+    tiers = [("2", "4Gi"), ("4", "8Gi"), ("1", "2Gi"), ("8", "16Gi")]
+    pods: List[Pod] = []
+    job = 0
+    i = 0
+    while i < n:
+        size = sizes[job % len(sizes)]
+        size = min(size, n - i)
+        monster = (job % 50) == 49
+        cpu, mem = ("64", "512Gi") if monster else tiers[job % len(tiers)]
+        for m in range(size):
+            pods.append(Pod(
+                name=f"job-{job}-w{m}",
+                labels={"app": f"job-{job}"},
+                requests=Resources.make(cpu=cpu, memory=mem),
+                pod_group=f"job-{job}",
+                min_member=size,
+                priority=job % 3,
+                creation_index=i + m,
+            ))
+        i += size
+        job += 1
+    return pods
+
+
 def flagship_pods(n: int, groups: int = 50) -> List[Pod]:
     """Config-4 workload, fully deterministic (no randomness by construction):
     every group spreads across zones (hard, maxSkew≥1); a third of groups also

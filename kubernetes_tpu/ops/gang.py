@@ -1,0 +1,158 @@
+"""Gang/co-scheduling: all-or-nothing pod groups on the wave engine
+(BASELINE config 5 — 5k nodes × 100k pods in groups).
+
+The reference has no in-tree gang scheduler (BASELINE.md: out-of-tree
+coscheduling only); the semantics implemented here are the sig-scheduling
+coscheduling protocol — a group of pods carrying a PodGroup with
+`spec.minMember` either gets ≥ minMember members placed (counting members
+already bound) or none at all — expressed the TPU way:
+
+  1. run the wave engine (ops/waves.py) over the full batch: every group's
+     members participate in the dense admission exactly like ungrouped pods,
+     so a feasible gang places in the SAME single dispatch as everything
+     else — no per-group what-if round-trips;
+  2. count per-group placements with one scatter-add; groups that reached
+     `needed` commit as-is;
+  3. underfilled groups are rejected and the wave fixpoint RESTARTS from the
+     original cycle state with the rejected groups' pods masked out — the
+     device-resident analog of the Permit plugin rejecting every waiting
+     member of a timed-out group (framework/v1alpha1/interface.go:339 +
+     waiting_pods_map.go: un-reserving a group returns its resources before
+     anyone else binds). Restarting (instead of subtracting the partial
+     group post-hoc) is what keeps the committed assignment a valid greedy
+     execution: pods that placed *because of* a rejected member (required
+     affinity) are re-decided, never left dangling.
+  4. rejection order resolves inter-group contention: when two groups split
+     a resource pocket and both underfill, the LOWEST-ranked group (min
+     member priority, then youngest) is rejected first and the survivors
+     re-place into the freed capacity — the batched analog of the
+     coscheduling plugin's per-group Permit timeout racing, made
+     deterministic. After `soft_rounds` single-rejections the remaining
+     underfilled groups reject together (bulk tail for many-group storms).
+
+The loop is a lax.while_loop around the wave fixpoint: zero host round-trips,
+one compiled program. Each iteration rejects ≥1 group, so it terminates in
+≤ GR+1 iterations; with no underfilled groups it runs the waves exactly once
+(the common case pays nothing over plain assign_waves).
+
+Soundness invariant (tests/test_gang.py): for every group, either
+placed ≥ needed or placed == 0 — no partial group ever commits — and the
+final assignment replays through the sequential oracle like any wave result.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..state.arrays import Array, ClusterTables, PodArrays
+from .assign import AssignResult, AssignState
+from .lattice import CycleArrays
+from .waves import assign_waves
+
+
+class GangArrays(NamedTuple):
+    """Per-cycle gang inputs (built host-side: state/encode.py
+    build_gang_arrays)."""
+
+    group: Array   # [P] i32 — group id per pending pod, -1 ungrouped
+    needed: Array  # [GR] i32 — members still required (minMember - bound)
+    valid: Array   # [GR] bool — group has members in this batch
+    rank: Array    # [GR] i32 — rejection priority; argmax rejects first
+
+
+class _GangCarry(NamedTuple):
+    rejected: Array    # [GR] bool
+    under: Array       # [GR] bool — underfilled in the latest run
+    placed: Array      # [GR] i32 — members placed in the latest run
+    rounds: Array      # scalar i32
+    node: Array        # [P] i32 latest assignment
+    feasible: Array    # [P] bool
+    waves: Array       # [P] i32 wave index per pod (tests/replay)
+    state: AssignState
+
+
+def _placed_per_group(gang: GangArrays, pods: PodArrays,
+                      feasible: Array) -> Array:
+    GR = gang.needed.shape[0]
+    g_safe = jnp.where(gang.group >= 0, gang.group, GR)
+    hit = (feasible & pods.valid).astype(jnp.int32)
+    return jnp.zeros((GR + 1,), jnp.int32).at[g_safe].add(hit)[:GR]
+
+
+def assign_gang(
+    tables: ClusterTables,
+    cyc: CycleArrays,
+    pods: PodArrays,
+    init: AssignState,
+    gang: GangArrays,
+    max_waves: int | None = None,
+    soft_rounds: int = 4,
+    engine_fn=None,
+    return_waves: bool = False,
+) -> tuple[AssignResult, Array]:
+    """Wave assignment with group-atomic admission. Returns the result plus
+    the [GR] rejected-group mask (host surfaces per-group events from it).
+    Pods of rejected groups come back node=-1/infeasible.
+
+    engine_fn(tables, cyc, pods, init) -> AssignResult lets the sequential
+    scan engine (ops/assign.py) serve as the executable spec for the gang
+    loop too; default is the wave engine."""
+    GR = gang.needed.shape[0]
+    P = pods.valid.shape[0]
+
+    def run(rejected: Array):
+        ok = (gang.group < 0) | ~rejected[jnp.clip(gang.group, 0, GR - 1)]
+        masked = pods._replace(valid=pods.valid & ok)
+        if engine_fn is not None:
+            res = engine_fn(tables, cyc, masked, init)
+            waves = jnp.full((P,), -1, jnp.int32)
+        else:
+            res, waves = assign_waves(tables, cyc, masked, init, max_waves,
+                                      return_waves=True)
+        placed = _placed_per_group(gang, masked, res.feasible)
+        under = gang.valid & ~rejected & (placed < gang.needed)
+        return res, waves, under, placed
+
+    res0, waves0, under0, placed0 = run(jnp.zeros((GR,), bool))
+
+    def cond(c: _GangCarry) -> Array:
+        return c.under.any() & (c.rounds < GR + 1)
+
+    def body(c: _GangCarry) -> _GangCarry:
+        # zero-placed underfilled groups hold NOTHING: excluding them frees
+        # no capacity, so no OTHER group's fill depends on them — reject
+        # them all at once (collapses statically-infeasible jobs into one
+        # extra round; a zero-placed group that might have filled after a
+        # partial rejection simply retries next cycle via the queue, the
+        # same deferral the Permit-timeout path gives it). PARTIALLY-filled
+        # groups do hold capacity; release them one per round (lowest rank
+        # first) so survivors absorb the freed space — until soft_rounds,
+        # after which the remaining tail rejects in bulk.
+        zero = c.under & (c.placed == 0)
+        partial = c.under & (c.placed > 0)
+        worst = jnp.argmax(jnp.where(partial, gang.rank, -1))
+        one = jnp.zeros((GR,), bool).at[worst].set(True) & partial
+        newly = zero | jnp.where(c.rounds >= soft_rounds, partial, one)
+        rejected = c.rejected | newly
+        res, waves, under, placed = run(rejected)
+        return _GangCarry(rejected=rejected, under=under, placed=placed,
+                          rounds=c.rounds + 1, node=res.node,
+                          feasible=res.feasible, waves=waves, state=res.state)
+
+    final = lax.while_loop(cond, body, _GangCarry(
+        rejected=jnp.zeros((GR,), bool), under=under0, placed=placed0,
+        rounds=jnp.int32(0), node=res0.node, feasible=res0.feasible,
+        waves=waves0, state=res0.state))
+
+    # the loop always exits with `under` empty (each round rejects ≥1 group,
+    # capped at GR+1); the strip below also covers the unreachable cap exit
+    dead = final.rejected | final.under
+    ok = (gang.group < 0) | ~dead[jnp.clip(gang.group, 0, GR - 1)]
+    result = AssignResult(node=jnp.where(ok, final.node, -1),
+                          feasible=final.feasible & ok, state=final.state)
+    if return_waves:
+        return result, dead, final.waves
+    return result, dead

@@ -93,13 +93,22 @@ def _schedule_batch_impl(
     ecfg=None,
     extra_plugins: tuple = (),
     extra_weights: tuple = (),
+    gang=None,
 ) -> AssignResult:
+    from ..ops.gang import assign_gang
     from ..ops.waves import assign_waves
 
     uk, ev = keys
     cyc = build_cycle(tables, existing, uk, ev, D, hard_weight, ecfg)
     cyc = _apply_extra_plugins(tables, cyc, extra_plugins, extra_weights)
     init = initial_state(tables, cyc)
+    if gang is not None:
+        # group-atomic admission (ops/gang.py); gang=None traces the plain
+        # engines, so gang-free batches compile/run exactly as before
+        res, _ = assign_gang(
+            tables, cyc, pending, init, gang,
+            engine_fn=assign_batch if engine == "scan" else None)
+        return res
     if engine == "scan":
         return assign_batch(tables, cyc, pending, init)
     return assign_waves(tables, cyc, pending, init)
@@ -110,7 +119,8 @@ def _schedule_batch(tables, pending, keys, D, existing,
                     hard_weight: float = 1.0,
                     ecfg=None,
                     extra_plugins: tuple = (),
-                    extra_weights: tuple = ()) -> AssignResult:
+                    extra_weights: tuple = (),
+                    gang=None) -> AssignResult:
     engine = _engine()
     if engine != "scan" and has_node_name:
         # spec.nodeName pods carry a per-POD (not per-class) host constraint
@@ -126,7 +136,7 @@ def _schedule_batch(tables, pending, keys, D, existing,
     return _schedule_batch_impl(tables, pending, keys, D, existing, engine,
                                 jnp.float32(hard_weight),
                                 ecfg or default_engine_config(),
-                                extra_plugins, extra_weights)
+                                extra_plugins, extra_weights, gang)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -228,9 +238,15 @@ class BatchScheduler:
 
         uk = jnp.int32(enc.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
         ev = jnp.int32(enc.vocabs.label_vals.get(""))
+        bound: Dict[int, int] = {}
+        for p in existing:
+            g = enc.group_id(p)
+            if g >= 0:
+                bound[g] = bound.get(g, 0) + 1
+        gang = enc.build_gang_arrays(list(pending), d, bound)
         res = _schedule_batch(
             jax.device_put(tables), jax.device_put(pe), (uk, ev), d.D,
-            jax.device_put(ex), has_node_name=d.has_node_name,
+            jax.device_put(ex), has_node_name=d.has_node_name, gang=gang,
         )
         node_idx = jax.device_get(res.node)
 

@@ -118,6 +118,21 @@ class Scheduler:
         from ..framework.plugins import extra_score_plugins
 
         self._extra_score = extra_score_plugins(framework)
+        # gang mechanism selection: the device gang engine (ops/gang.py)
+        # owns pod groups UNLESS the Coscheduling Permit plugin is enabled —
+        # then the host waiting-map path does (one mechanism per config;
+        # both holding the same group would double-gate it). The plugin is
+        # auto-wired here: releases complete through complete_waiting, and
+        # quorum counts come from the cache's group accounting.
+        self._device_gangs = True
+        if framework is not None:
+            for p in getattr(framework, "permit_plugins", ()):
+                if getattr(p, "name", "") == "Coscheduling":
+                    self._device_gangs = False
+                    if getattr(p, "on_release", None) is None:
+                        p.on_release = self.complete_waiting
+                    if getattr(p, "bound_count", None) is None:
+                        p.bound_count = self.cache.group_bound_count
         # key → (attempts, CycleState, node_name, original pod, binder_ext)
         self._waiting_meta: Dict[str, Tuple] = {}
         self.waiting_bind_errors = 0  # bind failures on the waiting-release path
@@ -233,7 +248,8 @@ class Scheduler:
                               hard_weight=self.hard_pod_affinity_weight,
                               ecfg=self.engine_config,
                               extra_plugins=extras,
-                              extra_weights=tuple(w for _, w in self._extra_score))
+                              extra_weights=tuple(w for _, w in self._extra_score),
+                              gang=snap.gang if self._device_gangs else None)
         node_idx = jax.device_get(res.node)
 
         failures: List[Tuple[Pod, int]] = []
@@ -255,7 +271,11 @@ class Scheduler:
         # preemptor could evict victims for space the wave already consumed)
         for pod, attempts in failures:
             handled = False
-            if self.preemptor is not None:
+            # gang pods never preempt individually: evicting victims to place
+            # ONE member of a group whose admission is all-or-nothing would
+            # trade running pods for a pod that may never commit (the
+            # coscheduling ecosystems gate preemption on the whole group)
+            if self.preemptor is not None and not pod.pod_group:
                 fresh = self.cache.snapshot(
                     self.encoder, [p for p, _ in failures], self.base_dims,
                     extra_intern=(UNSCHEDULABLE_TAINT_KEY,),

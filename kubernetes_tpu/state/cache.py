@@ -97,6 +97,8 @@ class Snapshot:
     pending_keys: Tuple[Tuple[str, int], ...]  # (pod key, object identity)
     existing_keys: Tuple[str, ...] = ()  # row order of `existing` (preemption
                                          # maps victim rows back to pod keys)
+    gang: object = None  # GangArrays (ops/gang.py) when any pending pod is
+                         # gang-grouped; None routes the plain engines
 
 
 class SchedulerCache:
@@ -134,6 +136,10 @@ class SchedulerCache:
         # introspection for tests/bench: how the last snapshot was produced
         self.last_snapshot_mode: str = ""   # "cached" | "patch" | "full"
         self.last_patch_rows: int = 0
+        # gang groups: bound/assumed member count per group key (ops/gang.py
+        # nets snapshot `needed` against these — minMember already satisfied
+        # by running members doesn't have to re-place)
+        self._group_bound: Dict[str, int] = {}
 
     # -- dirty-tracking helpers (callers hold self._mu) -- #
 
@@ -142,12 +148,29 @@ class SchedulerCache:
             self._by_node.setdefault(pod.node_name, {})[pod.key] = pod
             self._dirty_nodes.add(pod.node_name)
         self._dirty_pods[pod.key] = pod
+        gk = pod.group_key
+        if gk:
+            self._group_bound[gk] = self._group_bound.get(gk, 0) + 1
 
     def _pod_unplaced(self, pod: Pod) -> None:
         if pod.node_name:
             self._by_node.get(pod.node_name, {}).pop(pod.key, None)
             self._dirty_nodes.add(pod.node_name)
         self._dirty_pods[pod.key] = None
+        gk = pod.group_key
+        if gk:
+            c = self._group_bound.get(gk, 0) - 1
+            if c > 0:
+                self._group_bound[gk] = c
+            else:
+                self._group_bound.pop(gk, None)
+
+    def group_bound_count(self, group_key: str) -> int:
+        """Bound/assumed members of a gang group (the Coscheduling plugin's
+        quorum source — assumed-but-waiting members count, exactly the set
+        this cache mirrors)."""
+        with self._mu:
+            return self._group_bound.get(group_key, 0)
 
     # ------------------------------------------------------------------ #
     # pod lifecycle (cache.go:283-517)
@@ -436,6 +459,14 @@ class SchedulerCache:
             "volsets": len(encoder.volset_reg),
         }
 
+    def _gang_arrays(self, encoder: Encoder, pending, d: Dims):
+        """Per-cycle GangArrays for the pending batch, netting each group's
+        `needed` against members already bound/assumed in this cache."""
+        bound = {encoder.pod_groups.get(gk): c
+                 for gk, c in self._group_bound.items()
+                 if encoder.pod_groups.get(gk) >= 0}
+        return encoder.build_gang_arrays(list(pending), d, bound)
+
     def _existing_pod_arrays(self, d: Dims) -> PodArrays:
         rows = self._staging_pod_rows
         return PodArrays(
@@ -474,6 +505,14 @@ class SchedulerCache:
         new_D = max(bucket(max_dom), floor_d)
         if new_D < d.D:
             d = replace(d, D=new_D)
+        # same for gang group ids: finished jobs would otherwise grow GR
+        # (and the full-re-encode cadence) forever
+        encoder.compact_groups(
+            [st.pod for st in self._pods.values()] + list(pending))
+        floor_gr = (base_dims.GR if base_dims is not None else Dims().GR)
+        new_GR = max(bucket(max(len(encoder.pod_groups), 1)), floor_gr)
+        if new_GR < d.GR:
+            d = replace(d, GR=new_GR)
         self._staging_nodes = encoder.empty_node_arrays(d)
         for i, n in enumerate(nodes):
             encoder.encode_node_row(
@@ -517,6 +556,7 @@ class SchedulerCache:
             dims=d,
             pending_keys=pending_keys,
             existing_keys=tuple(self._pod_keys),
+            gang=self._gang_arrays(encoder, pending, d),
         )
         self._encoder = encoder
         self._reg_sizes = self._registry_sizes(encoder)
@@ -635,6 +675,7 @@ class SchedulerCache:
             dims=d,
             pending_keys=pending_keys,
             existing_keys=tuple(self._pod_keys),
+            gang=self._gang_arrays(encoder, pending, d),
         )
         self._dirty_nodes.clear()
         self._dirty_pods.clear()

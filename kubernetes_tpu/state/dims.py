@@ -74,6 +74,7 @@ class Dims:
     SC: int = 8       # distinct pod classes (templates)
     K: int = 4        # topology keys
     D: int = 8        # max domains per topology key
+    GR: int = 4       # gang pod groups (all-or-nothing; ops/gang.py)
     NW: int = 1       # namespace bitset words (32 ns per word)
     PWp: int = 1      # (proto,port) pair bitset words
     PWt: int = 1      # (proto,port,ip) triple bitset words

@@ -105,6 +105,50 @@ class Encoder:
         self.image_sizes: List[int] = []  # KiB, parallel to vocabs.images
         self.volset_reg = Vocab()   # sorted ((vol_id, driver_id, ro), …)
         self.vol_driver: List[int] = []  # driver id per volume vocab id
+        # gang pod groups (BASELINE config 5; ops/gang.py): group key → id +
+        # effective minMember per id. UNLIKE every other vocab these are
+        # compactable (compact_groups): gang jobs churn per-job, and dead
+        # ids would otherwise grow GR — and with it GangArrays and the full-
+        # re-encode cadence — forever. Nothing device-resident stores group
+        # ids between snapshots, which is what makes compaction safe.
+        self.pod_groups = Vocab()
+        self.group_min: Dict[int, int] = {}
+        # authoritative minMember per group KEY (PodGroup objects); survives
+        # compaction, overrides pod-carried hints
+        self.group_spec: Dict[str, int] = {}
+
+    # ---------------- gang groups ---------------- #
+
+    def group_id(self, p: Pod) -> int:
+        """Intern a pod's gang group; -1 for ungrouped pods. Folds the
+        pod-carried minMember hint into the group's effective minimum."""
+        key = p.group_key
+        if not key:
+            return -1
+        g = self.pod_groups.intern(key)
+        spec = self.group_spec.get(key)
+        if spec is not None:
+            self.group_min[g] = spec
+        elif p.min_member > self.group_min.get(g, 0):
+            self.group_min[g] = p.min_member
+        return g
+
+    def set_group_min(self, group_key: str, min_member: int) -> None:
+        """Authoritative minMember from a PodGroup object (overrides
+        pod-carried hints)."""
+        self.group_spec[group_key] = int(min_member)
+        g = self.pod_groups.get(group_key)
+        if g >= 0:
+            self.group_min[g] = int(min_member)
+
+    def compact_groups(self, live_pods) -> None:
+        """Drop dead group ids, re-interning only groups that still have
+        live pods — the gang analog of rebuild_domain_maps, called at full
+        re-encode time (the free moment: every array rebuilds anyway)."""
+        self.pod_groups = Vocab()
+        self.group_min = {}
+        for p in live_pods:
+            self.group_id(p)
 
     # ---------------- sub-object interning ---------------- #
 
@@ -286,7 +330,9 @@ class Encoder:
         Memoized by object identity (the keepalive reference makes id() safe),
         so a pod is walked ONCE when it first appears — the analog of the
         reference encoding a pod into NodeInfo once per informer event, not
-        once per cycle (cache.go:394)."""
+        once per cycle (cache.go:394). Gang group ids are deliberately NOT a
+        column: they are compactable (compact_groups) and a memoized copy
+        would go stale; build_gang_arrays re-derives them per snapshot."""
         ent = self._pod_rows.get(id(p))
         if ent is not None and ent[0] is p:
             return ent[1]
@@ -406,6 +452,7 @@ class Encoder:
             SC=max(len(self.class_reg), 1),
             K=max(len(v.topo_keys), 1),
             D=max_domains,
+            GR=max(len(self.pod_groups), 1),
             NW=(len(v.namespaces) + 31) // 32 or 1,
             PWp=(len(v.port_pairs) + 31) // 32 or 1,
             PWt=(len(v.port_triples) + 31) // 32 or 1,
@@ -743,6 +790,48 @@ class Encoder:
             priority=rows[:, 3], creation=rows[:, 4],
             node_id=node_id, node_name_req=rows[:, 5],
         )
+
+    def build_gang_arrays(self, pending: Sequence[Pod], d: Dims,
+                          bound_counts: Optional[Dict[int, int]] = None):
+        """GangArrays for one cycle (ops/gang.py): per-pending-pod group ids
+        plus per-group needed counts, netting out members already bound
+        (`bound_counts`: group id → bound/assumed member count). Returns None
+        when no pending pod is gang-grouped — the dispatch layer then traces
+        the plain (gang-free) engine."""
+        from ..ops.gang import GangArrays
+
+        # cheap attr scan first: gang-free batches (the common flagship
+        # cycle) pay one falsy check per pod, not a group_id walk
+        if not any(p.pod_group for p in pending):
+            return None
+        gids = [self.group_id(p) for p in pending]
+        GR, P = d.GR, d.P
+        group = np.full((P,), -1, I32)
+        group[: len(gids)] = np.array(gids, I32) if gids else 0
+        needed = np.zeros((GR,), I32)
+        valid = np.zeros((GR,), bool)
+        bound_counts = bound_counts or {}
+        # only groups with members IN THIS BATCH participate: an absent
+        # group's needed>0 would read as permanently underfilled and spin
+        # the engine's rejection loop for pods that are not even here
+        present = {g for g in gids if g >= 0}
+        for g in present:
+            if g < GR:
+                valid[g] = True
+                needed[g] = max(
+                    self.group_min.get(g, 0) - bound_counts.get(g, 0), 0)
+        # rejection order: lowest max-member-priority first, then youngest
+        # (latest min creation) — the coscheduling queue-sort inverted
+        pri = np.full((GR,), -(2**31) + 1, I32)
+        cre = np.full((GR,), 2**31 - 1, I32)
+        for p, g in zip(pending, gids):
+            if 0 <= g < GR:
+                pri[g] = max(pri[g], p.priority)
+                cre[g] = min(cre[g], p.creation_index)
+        order = np.lexsort((-cre, pri))  # ascending priority, youngest first
+        rank = np.zeros((GR,), I32)
+        rank[order] = np.arange(GR - 1, -1, -1, dtype=I32)
+        return GangArrays(group=group, needed=needed, valid=valid, rank=rank)
 
     # ---------------- one-shot full encode ---------------- #
 

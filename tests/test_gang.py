@@ -1,0 +1,358 @@
+"""Gang/co-scheduling (ops/gang.py + the Coscheduling Permit plugin).
+
+The soundness bar mirrors tests/test_waves.py: beyond unit behavior, the
+gang engine's output must (a) never commit a partial group — for every group,
+placed ≥ needed or placed == 0 — and (b) remain a valid greedy execution of
+the reference's per-pod loop when replayed through the pure-Python oracle.
+"""
+
+import dataclasses
+import functools
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import Node, Pod, PodGroup, Resources
+from kubernetes_tpu.api.v1 import pod_from_v1, pod_to_v1
+from kubernetes_tpu.ops.assign import initial_state
+from kubernetes_tpu.ops.gang import assign_gang
+from kubernetes_tpu.ops.lattice import build_cycle
+from kubernetes_tpu.sched.cycle import UNSCHEDULABLE_TAINT_KEY, BatchScheduler
+from kubernetes_tpu.state.encode import Encoder
+
+from test_golden import oracle_fits, rand_node, rand_pod
+
+
+def mknodes(n, cpu="4"):
+    return [Node(name=f"n{i}",
+                 allocatable=Resources.make(cpu=cpu, memory="8Gi", pods=110))
+            for i in range(n)]
+
+
+def gang_pods(prefix, count, group, min_member, cpu="1", priority=0, base=0):
+    return [Pod(name=f"{prefix}{i}", requests=Resources.make(cpu=cpu),
+                pod_group=group, min_member=min_member,
+                priority=priority, creation_index=base + i)
+            for i in range(count)]
+
+
+class TestAllOrNothing:
+    def test_feasible_group_places_fully(self):
+        res = BatchScheduler().schedule(
+            mknodes(4), [], gang_pods("a", 4, "jobA", 4))
+        assert res.scheduled == 4 and res.failed == 0
+
+    def test_infeasible_group_places_nothing(self):
+        # 4 nodes × 4cpu; 6 members × 3cpu need 6 nodes — minMember 6 can
+        # never fill, so NOT EVEN the 4 that would fit may commit
+        res = BatchScheduler().schedule(
+            mknodes(4), [], gang_pods("b", 6, "jobB", 6, cpu="3"))
+        assert res.scheduled == 0 and res.failed == 6
+
+    def test_min_member_below_count_allows_partial_above_min(self):
+        # group of 6, minMember 4, capacity for exactly 4 (one 3cpu per node):
+        # quorum is met → the 4 that fit commit, 2 stay pending
+        res = BatchScheduler().schedule(
+            mknodes(4), [], gang_pods("c", 6, "jobC", 4, cpu="3"))
+        assert res.scheduled == 4 and res.failed == 2
+
+    def test_ungrouped_pods_unaffected_by_rejections(self):
+        pods = gang_pods("d", 6, "jobD", 6, cpu="3") + [
+            Pod(name="solo", requests=Resources.make(cpu="1"),
+                creation_index=50)]
+        res = BatchScheduler().schedule(mknodes(4), [], pods)
+        assert res.assignments[-1] is not None  # solo pod still placed
+        assert all(a is None for a in res.assignments[:6])
+
+    def test_bound_members_count_toward_quorum(self):
+        # 2 members already bound; minMember 4; only 2 more can fit → the
+        # pending pair commits because needed nets to 2
+        nodes = mknodes(4)
+        bound = [dataclasses.replace(p, node_name=f"n{i}")
+                 for i, p in enumerate(
+                     gang_pods("e", 2, "jobE", 4, cpu="3"))]
+        res = BatchScheduler().schedule(
+            nodes, bound, gang_pods("f", 2, "jobE", 4, cpu="3", base=10))
+        assert res.scheduled == 2
+
+
+class TestContention:
+    def test_older_group_wins_resource_pocket(self):
+        # 16 cpu total; two gangs each needing all 16 — naive half-split
+        # underfills both; rejection order must fully place the older one
+        gA = gang_pods("a", 8, "jobA", 8, cpu="2", base=0)
+        gB = gang_pods("b", 8, "jobB", 8, cpu="2", base=100)
+        res = BatchScheduler().schedule(mknodes(4), [], gA + gB)
+        a = res.assignments
+        assert all(x is not None for x in a[:8])
+        assert all(x is None for x in a[8:])
+
+    def test_higher_priority_group_wins(self):
+        gA = gang_pods("a", 8, "jobA", 8, cpu="2", base=0)
+        gC = gang_pods("c", 8, "jobC", 8, cpu="2", base=200, priority=100)
+        res = BatchScheduler().schedule(mknodes(4), [], gA + gC)
+        a = res.assignments
+        assert all(x is None for x in a[:8])
+        assert all(x is not None for x in a[8:])
+
+    def test_three_way_contention_converges(self):
+        # capacity for exactly one gang; three compete; exactly one fills
+        gangs = [gang_pods(p, 8, f"job{p}", 8, cpu="2", base=i * 100)
+                 for i, p in enumerate("xyz")]
+        res = BatchScheduler().schedule(
+            mknodes(4), [], [p for g in gangs for p in g])
+        placed = [sum(a is not None for a in res.assignments[i*8:(i+1)*8])
+                  for i in range(3)]
+        assert sorted(placed) == [0, 0, 8]
+        assert placed[0] == 8  # deterministic: the oldest
+
+
+def _encode(nodes, existing, pending):
+    enc = Encoder()
+    enc.vocabs.label_keys.intern(UNSCHEDULABLE_TAINT_KEY)
+    enc.vocabs.label_vals.intern("")
+    tables, ex, pe, d = enc.encode_cluster(nodes, existing, pending, None)
+    uk = jnp.int32(enc.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+    ev = jnp.int32(enc.vocabs.label_vals.get(""))
+    gang = enc.build_gang_arrays(pending, d)
+    return tables, ex, pe, gang, uk, ev, d
+
+
+@functools.partial(jax.jit, static_argnums=(6,))
+def _run_gang(tables, ex, pe, gang, uk, ev, D):
+    cyc = build_cycle(tables, ex, uk, ev, D)
+    init = initial_state(tables, cyc)
+    return assign_gang(tables, cyc, pe, init, gang, return_waves=True)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_gang_soundness_randomized(seed):
+    """Randomized clusters with random gangs layered on adversarial pods:
+    (a) no partial group ever commits; (b) the final assignment replays
+    through the full oracle predicate chain in (wave, queue) order."""
+    rng = random.Random(7000 + seed)
+    n_nodes = rng.randint(4, 8)
+    nodes = [rand_node(rng, i) for i in range(n_nodes)]
+    existing = [rand_pod(rng, 100 + i, bound_to=rng.choice(nodes).name)
+                for i in range(rng.randint(0, 4))]
+    pending = [rand_pod(rng, i) for i in range(rng.randint(8, 14))]
+    # group a random subset into 1-3 gangs with random minMember
+    n_groups = rng.randint(1, 3)
+    for i, p in enumerate(pending):
+        if rng.random() < 0.6:
+            g = rng.randrange(n_groups)
+            pending[i] = dataclasses.replace(
+                p, pod_group=f"g{g}", min_member=rng.randint(1, 4))
+
+    tables, ex, pe, gang, uk, ev, d = _encode(nodes, existing, pending)
+    if gang is None:
+        pytest.skip("no gang pods drawn")
+    res, dead, waves = _run_gang(tables, ex, pe, gang, uk, ev, d.D)
+    node_idx = np.asarray(res.node)[: len(pending)]
+    wave_idx = np.asarray(waves)[: len(pending)]
+
+    # (a) all-or-nothing per group — keyed by NAMESPACED group (rand_pod
+    # draws mixed namespaces; "ns1/g0" and "ns2/g0" are distinct gangs)
+    enc_groups = {}
+    for i, p in enumerate(pending):
+        if p.pod_group:
+            enc_groups.setdefault(f"{p.namespace}/{p.pod_group}", []).append(i)
+    for gname, members in enc_groups.items():
+        placed = sum(node_idx[i] >= 0 for i in members)
+        needed = max(p.min_member for p in
+                     (pending[i] for i in members))
+        assert placed == 0 or placed >= needed, (
+            f"seed={seed}: group {gname} committed {placed} members, "
+            f"needed {needed} — partial commit")
+
+    # (b) oracle replay in (wave, queue) order
+    placed = sorted(
+        (int(wave_idx[i]), -pending[i].priority, pending[i].creation_index, i)
+        for i in range(len(pending)) if node_idx[i] >= 0)
+    world = list(existing)
+    for _, _, _, i in placed:
+        node = nodes[int(node_idx[i])]
+        assert oracle_fits(pending[i], node, nodes, world), (
+            f"seed={seed}: gang-path pod {pending[i].name} on {node.name} "
+            f"violates the oracle at replay")
+        world.append(dataclasses.replace(pending[i], node_name=node.name))
+
+
+class TestStatefulScheduler:
+    def _mk(self):
+        from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+
+        binder = RecordingBinder()
+        s = Scheduler(binder=binder)
+        return s, binder
+
+    def test_gang_via_snapshot_path(self):
+        s, binder = self._mk()
+        for n in mknodes(4):
+            s.on_node_add(n)
+        for p in gang_pods("a", 4, "jobA", 4):
+            s.on_pod_add(p)
+        for p in gang_pods("b", 6, "jobB", 6, cpu="3", base=10):
+            s.on_pod_add(p)
+        stats = s.schedule_pending()
+        assert stats.scheduled == 4
+        assert stats.unschedulable == 6
+        assert {k for k, _ in binder.bound} == {
+            f"default/a{i}" for i in range(4)}
+
+    def test_rejected_gang_retries_when_capacity_frees(self):
+        from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+
+        now = [0.0]
+        binder = RecordingBinder()
+        s = Scheduler(binder=binder, clock=lambda: now[0])
+        for n in mknodes(2):
+            s.on_node_add(n)
+        # occupy the cluster: group can't fill → rejected → queued
+        blocker = [Pod(name=f"x{i}", requests=Resources.make(cpu="4"),
+                       node_name=f"n{i}", creation_index=i)
+                   for i in range(2)]
+        for p in blocker:
+            s.on_pod_add(p)
+        for p in gang_pods("g", 2, "jobG", 2, cpu="3", base=10):
+            s.on_pod_add(p)
+        assert s.schedule_pending().scheduled == 0
+        # free capacity; advance past the retry backoff; the flush retries
+        for p in blocker:
+            s.on_pod_delete(p)
+        now[0] = 60.0
+        stats = s.run_until_idle()
+        assert len(binder.bound) == 2
+
+    def test_gang_bound_counts_net_out_in_cache(self):
+        s, binder = self._mk()
+        for n in mknodes(4):
+            s.on_node_add(n)
+        # two members bound out-of-band count toward jobE's minMember 4
+        for i, p in enumerate(gang_pods("e", 2, "jobE", 4, cpu="3")):
+            s.on_pod_add(dataclasses.replace(p, node_name=f"n{i}"))
+        for p in gang_pods("f", 2, "jobE", 4, cpu="3", base=10):
+            s.on_pod_add(p)
+        assert s.schedule_pending().scheduled == 2
+
+
+class TestCoschedulingPermitPlugin:
+    """The host per-pod path: Permit WAIT until quorum, then release
+    (framework/plugins.py Coscheduling; waiting_pods_map semantics)."""
+
+    def _mk(self, min_member=3, timeout=30.0):
+        from kubernetes_tpu.framework.plugins import (
+            default_framework, default_plugins,
+        )
+        from kubernetes_tpu.framework.runtime import PluginSet
+        from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+
+        plugins = dataclasses.replace(
+            default_plugins(),
+            reserve=PluginSet(enabled=["Coscheduling"]),
+            permit=PluginSet(enabled=["Coscheduling"]),
+            unreserve=PluginSet(enabled=["Coscheduling"]),
+        )
+        fw = default_framework(plugins=plugins)
+        binder = RecordingBinder()
+        s = Scheduler(binder=binder, framework=fw, batch_size=1)
+        cos = next(p for p in fw.permit_plugins if p.name == "Coscheduling")
+        cos.on_release = s.complete_waiting
+        cos.timeout = timeout
+        return s, binder, cos
+
+    def test_members_wait_then_release_on_quorum(self):
+        s, binder, cos = self._mk()
+        cos.register_group("default/jobP", 3)
+        for n in mknodes(4):
+            s.on_node_add(n)
+        members = gang_pods("p", 3, "jobP", 3)
+        # batch_size=1 → one member per wave: first two park in Permit WAIT
+        s.on_pod_add(members[0])
+        s.schedule_pending()
+        assert len(binder.bound) == 0
+        assert len(s.framework.waiting_pods()) == 1
+        s.on_pod_add(members[1])
+        s.schedule_pending()
+        assert len(binder.bound) == 0
+        assert len(s.framework.waiting_pods()) == 2
+        # third member reaches quorum: releases both waiters + binds itself
+        s.on_pod_add(members[2])
+        s.schedule_pending()
+        assert len(binder.bound) == 3
+        assert len(s.framework.waiting_pods()) == 0
+
+    def test_timeout_rejects_and_requeues_waiters(self):
+        s, binder, cos = self._mk(timeout=5.0)
+        cos.register_group("default/jobQ", 3)
+        for n in mknodes(4):
+            s.on_node_add(n)
+        s.on_pod_add(gang_pods("q", 1, "jobQ", 3)[0])
+        s.schedule_pending()
+        assert len(s.framework.waiting_pods()) == 1
+        # jump the clock past the permit deadline (relative to the framework
+        # clock, which stamped the waiting deadline with time.monotonic())
+        import time as _time
+
+        base = _time.monotonic()
+        s.clock = lambda: base + 10_000.0
+        s.expire_waiting()
+        assert len(s.framework.waiting_pods()) == 0
+        assert len(binder.bound) == 0
+        # the waiter was unreserved: the group's reserved set is empty again
+        assert not cos._reserved.get("default/jobQ")
+
+
+def test_group_ids_compact_on_full_snapshot():
+    """Finished gang jobs must not grow GR forever: a full re-encode
+    compacts dead group ids (the gang analog of domain-map compaction), so
+    a long-running scheduler's GangArrays stay sized to LIVE groups."""
+    from kubernetes_tpu.sched.cycle import snapshot_with_keys
+    from kubernetes_tpu.state.cache import SchedulerCache
+
+    cache = SchedulerCache()
+    enc = Encoder()
+    for n in mknodes(4):
+        cache.add_node(n)
+    # churn many short-lived gangs through the encoder
+    for j in range(200):
+        for p in gang_pods("w", 2, f"job-{j}", 2, base=j * 10):
+            enc.group_id(p)
+    assert len(enc.pod_groups) >= 200
+    # a full snapshot with one live gang compacts the vocab to just it
+    live = gang_pods("live", 2, "job-live", 2, base=9000)
+    snap, _ = snapshot_with_keys(cache, enc, live, None)
+    assert cache.last_snapshot_mode == "full"
+    assert len(enc.pod_groups) == 1
+    assert snap.dims.GR <= 4  # floor, not the churned 200+
+    assert snap.gang is not None and int(snap.gang.valid.sum()) == 1
+
+
+def test_podgroup_object_overrides_pod_hints():
+    enc = Encoder()
+    enc.set_group_min("default/jobZ", 7)
+    p = Pod(name="z0", pod_group="jobZ", min_member=2)
+    g = enc.group_id(p)
+    assert enc.group_min[g] == 7  # authoritative PodGroup wins over the hint
+
+
+def test_gang_annotations_round_trip_v1():
+    p = Pod(name="w0", pod_group="trainers", min_member=16,
+            requests=Resources.make(cpu="2"))
+    back = pod_from_v1(pod_to_v1(p))
+    assert back.pod_group == "trainers"
+    assert back.min_member == 16
+    # label-carried form parses too
+    obj = pod_to_v1(p)
+    obj["metadata"].pop("annotations")
+    obj["metadata"]["labels"][
+        "pod-group.scheduling.sigs.k8s.io/name"] = "trainers"
+    assert pod_from_v1(obj).pod_group == "trainers"
+
+
+def test_podgroup_object_key():
+    g = PodGroup(name="train", namespace="ml", min_member=8)
+    assert g.key == "ml/train"
