@@ -59,6 +59,7 @@ DEFAULT_STAGES = [
     (5000, 50000, "flagship"),
     (5000, 50000, "density"),
     (5000, 100000, "gang"),
+    (2000, 16000, "growth"),
 ]
 
 
@@ -127,6 +128,116 @@ def _probe_backend(timeout):
     return _cpu_env(os.environ), "cpu (tpu init failed)", diags
 
 
+def _growth_stage(n_start, n_pods):
+    """The cold-compile-cliff scenario (VERDICT r3 weakness #1): a live
+    cluster grows across a Dims capacity bucket while scheduling. The
+    prewarmer must compile the next bucket in the BACKGROUND — cycles keep
+    running during the compile, and the first post-boundary cycle pays at
+    most a persistent-cache load, never the full XLA compile."""
+    import itertools
+
+    import jax
+
+    from kubernetes_tpu.api.types import Pod, Resources
+    from kubernetes_tpu.models.workloads import make_nodes
+    from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+    from kubernetes_tpu.state.dims import bucket
+
+    nodes = make_nodes(n_start)
+    boundary = bucket(n_start)
+    # size the E axis so it stays INSIDE one bucket for the whole stage
+    # (seed 70% + ≤8% in flight < the 80% prewarm threshold and < 100%):
+    # a live cluster near an N boundary has a stable bound-pod population,
+    # and E churning through buckets would mask the N-boundary measurement
+    e_bucket = 1 << max(n_pods - 1, 1).bit_length()
+    seed_n = int(0.70 * e_bucket)
+    batch = max(int(0.08 * e_bucket), 64)
+    s = Scheduler(binder=RecordingBinder(), batch_size=batch)
+    for n in nodes:
+        s.on_node_add(n)
+    for i in range(seed_n):
+        s.on_pod_add(Pod(name=f"seed-{i}", node_name=nodes[i % n_start].name,
+                         requests=Resources.make(cpu="100m", memory="64Mi"),
+                         creation_index=i))
+
+    # unbounded pending supply + post-cycle churn (scheduled pods complete
+    # and leave): the stage cycles for as long as the background compile
+    # runs, with E returning to its seed level every cycle
+    counter = itertools.count(seed_n)
+    in_flight = {}
+
+    def feed(k):
+        for _ in range(k):
+            i = next(counter)
+            p = Pod(name=f"p-{i}",
+                    requests=Resources.make(cpu="20m", memory="8Mi"),
+                    creation_index=i)
+            in_flight[p.key] = p
+            s.on_pod_add(p)
+        return k
+
+    def churn(stats):
+        import dataclasses
+
+        for key, node_name in stats.assignments.items():
+            p = in_flight.pop(key, None)
+            if p is not None:
+                s.on_pod_delete(dataclasses.replace(p, node_name=node_name))
+
+    # warm the CURRENT bucket (ordinary first-compile, measured separately)
+    feed(s.batch_size)
+    t0 = time.perf_counter()
+    churn(s.schedule_pending())
+    t_warm = time.perf_counter() - t0
+
+    # cycle while the prewarmer compiles the NEXT bucket in the background
+    # (occupancy n_start/boundary ≥ 80% fires it on the first cycle above)
+    t0 = time.perf_counter()
+    cycles_during_prewarm = 0
+    max_cycle_during_prewarm = 0.0
+    while (s.prewarmer._inflight is None or
+           s.prewarmer._inflight.is_alive()):
+        feed(s.batch_size)
+        c0 = time.perf_counter()
+        churn(s.schedule_pending())
+        dt = time.perf_counter() - c0
+        max_cycle_during_prewarm = max(max_cycle_during_prewarm, dt)
+        cycles_during_prewarm += 1
+        if time.perf_counter() - t0 > 900:
+            break
+        if s.prewarmer._inflight is None and cycles_during_prewarm > 3:
+            break  # prewarm thread never started (axis below min_axis)
+    t_prewarm = time.perf_counter() - t0
+
+    # cross the boundary: add nodes past the bucket, next cycle recompiles
+    # — or, with the prewarm in the cache, just reloads
+    extra = make_nodes(boundary + 8)[n_start:]
+    for n in extra:
+        s.on_node_add(n)
+    feed(s.batch_size)
+    t0 = time.perf_counter()
+    stats = s.schedule_pending()
+    t_boundary = time.perf_counter() - t0
+
+    if stats.scheduled == 0:
+        print(json.dumps({"nodes": n_start, "pods": n_pods, "kind": "growth",
+                          "error": "boundary cycle scheduled nothing"}))
+        return
+    print(json.dumps({
+        "nodes": n_start, "pods": n_pods, "kind": "growth",
+        "scheduled": stats.scheduled, "failed": stats.unschedulable,
+        "bucket_boundary": boundary,
+        "warmup_seconds": round(t_warm, 1),
+        "prewarm_background_seconds": round(t_prewarm, 1),
+        "cycles_during_prewarm": cycles_during_prewarm,
+        "max_cycle_during_prewarm": round(max_cycle_during_prewarm, 3),
+        "boundary_cycle_seconds": round(t_boundary, 3),
+        "cycle_seconds": round(t_boundary, 3),
+        "pods_per_sec": round(stats.scheduled / t_boundary, 1),
+        "backend": jax.default_backend(),
+    }))
+
+
 def _stage_main(n_nodes, n_pods, kind):
     """Child process: one shape, one JSON line on stdout."""
     from kubernetes_tpu.utils.platform import (
@@ -134,6 +245,10 @@ def _stage_main(n_nodes, n_pods, kind):
 
     ensure_cpu_backend_safe()
     enable_compile_cache()
+
+    if kind == "growth":
+        _growth_stage(n_nodes, n_pods)
+        return
 
     import jax
 

@@ -1,0 +1,168 @@
+"""Compile-ahead for capacity-bucket growth: kill the cold-compile cliff.
+
+Capacities bucket to coarse shapes (state/dims.py) so steady-state cycles hit
+one compiled program — but CROSSING a bucket (cluster grows past 2,048 nodes,
+existing pods double past E) swaps the shape signature and pays a fresh XLA
+compile, which at 2k+ nodes is minutes (BENCH_r03: 106 s at the 2k×20k
+bucket). In a live cluster that is a scheduling stall at exactly the moment
+the cluster is growing.
+
+The fix is the same trick ahead-of-time-compiled systems use: when occupancy
+of a growing axis crosses `threshold` (default 80%), a background thread
+AOT-compiles the NEXT bucket's program from abstract shapes only —
+`jit(...).lower(ShapeDtypeStructs).compile()` needs no real arrays and no
+device dispatch. The persistent compilation cache (utils/platform.py
+enable_compile_cache) is keyed by the HLO, so when the live path first calls
+with the new shapes it deserializes the already-built executable (~seconds)
+instead of compiling (~minutes). The scheduler keeps cycling on the current
+bucket the whole time; nothing blocks.
+
+The reference needs no analog (Go is AOT-compiled; its scheduler has no
+shape-specialized programs) — this is pure XLA-runtime plumbing, documented
+in docs/PERF.md.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import replace
+from typing import Callable, Optional
+
+from ..state.dims import Dims
+
+# axes that grow monotonically in a live cluster and cross buckets: nodes,
+# bound pods. (P — the pending batch — is bounded by batch_size and churns
+# rather than grows.)
+_GROWTH_AXES = ("N", "E")
+
+
+def abstract_cycle_args(d: Dims, gang: bool = False):
+    """ShapeDtypeStruct pytrees for one _schedule_batch_impl call at dims
+    `d` — built from a throwaway Encoder's empty tables, so shapes/dtypes
+    and pytree structure are BY CONSTRUCTION the ones the live path passes.
+    `gang=True` adds abstract GangArrays (gang-bearing batches trace a
+    structurally different program — the restart loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.gang import GangArrays
+    from ..ops.lattice import default_engine_config
+    from ..state.arrays import ClusterTables
+    from ..state.encode import Encoder
+
+    enc = Encoder()
+    tables = ClusterTables(
+        nodes=enc.empty_node_arrays(d),
+        reqs=enc.build_req_table(d),
+        labelsets=enc.build_labelset_table(d),
+        nterms=enc.build_nterm_table(d),
+        tolsets=enc.build_tolset_table(d),
+        portsets=enc.build_portset_table(d),
+        terms=enc.build_term_table(d),
+        classes=enc.build_class_table(d),
+        images=enc.build_image_table(d),
+        zone_keys=enc.build_zone_keys(),
+        volsets=enc.build_volset_table(d),
+        drv_masks=enc.build_drv_masks(d),
+    )
+    pending = enc.build_pod_arrays([], d, capacity=d.P)
+    existing = enc.build_pod_arrays([], d, capacity=d.E)
+    abstract = lambda t: jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
+    scalar_i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    scalar_f32 = jax.ShapeDtypeStruct((), jnp.float32)
+    gang_args = None
+    if gang:
+        gang_args = GangArrays(
+            group=jax.ShapeDtypeStruct((d.P,), jnp.int32),
+            needed=jax.ShapeDtypeStruct((d.GR,), jnp.int32),
+            valid=jax.ShapeDtypeStruct((d.GR,), jnp.bool_),
+            rank=jax.ShapeDtypeStruct((d.GR,), jnp.int32),
+        )
+    return (abstract(tables), abstract(pending), (scalar_i32, scalar_i32),
+            abstract(existing), scalar_f32,
+            jax.tree.map(lambda _: scalar_f32, default_engine_config()),
+            gang_args)
+
+
+class BucketPrewarmer:
+    """Watches per-cycle occupancy and compiles the next bucket ahead of
+    need. One in-flight compile at a time; each (dims, engine) signature is
+    warmed at most once per process."""
+
+    def __init__(self, threshold: float = 0.8, min_axis: int = 256,
+                 compile_fn: Optional[Callable] = None):
+        # min_axis: below this capacity a fresh compile is cheap enough that
+        # warming would just burn test/laptop CPU — skip.
+        # KTPU_PREWARM_MIN_AXIS overrides (small-shape bench validation).
+        import os
+
+        self.threshold = threshold
+        self.min_axis = int(os.environ.get("KTPU_PREWARM_MIN_AXIS", min_axis))
+        self._warmed: set = set()
+        self._mu = threading.Lock()
+        self._inflight: Optional[threading.Thread] = None
+        self._compile_fn = compile_fn or self._compile
+        self.warm_log: list = []   # (dims, engine) actually compiled — tests
+
+    def observe(self, d: Dims, n_nodes: int, n_existing: int,
+                engine: str = "waves", extras: tuple = (),
+                gang: bool = False) -> None:
+        """Call once per cycle with live occupancy (and whether batches are
+        gang-bearing — gangs trace a different program). Cheap when nothing
+        is near a boundary. Warms one target per call; multiple crossing
+        axes warm on successive cycles (single-axis targets first — the
+        common case is one axis crossing at a time — then the joint one)."""
+        live = {"N": n_nodes, "E": n_existing}
+        crossing = [ax for ax in _GROWTH_AXES
+                    if getattr(d, ax) >= self.min_axis
+                    and live[ax] >= self.threshold * getattr(d, ax)]
+        if not crossing:
+            return
+        targets = [d.grown_for(**{ax: getattr(d, ax) + 1}) for ax in crossing]
+        if len(crossing) > 1:
+            targets.append(d.grown_for(
+                **{ax: getattr(d, ax) + 1 for ax in crossing}))
+        for target in targets:
+            if target == d:
+                continue
+            key = (replace(target, has_node_name=False), engine, extras, gang)
+            with self._mu:
+                if key in self._warmed:
+                    continue
+                if self._inflight is not None and self._inflight.is_alive():
+                    return  # one compile at a time; retry next cycle
+                self._warmed.add(key)
+                t = threading.Thread(
+                    target=self._compile_fn,
+                    args=(target, engine, extras, gang),
+                    name=f"ktpu-prewarm-{target.N}x{target.E}", daemon=True)
+                self._inflight = t
+                t.start()
+            return
+
+    def _compile(self, d: Dims, engine: str, extras: tuple,
+                 gang: bool) -> None:
+        try:
+            from .cycle import _schedule_batch_impl
+
+            (tables, pending, keys, existing, hw, ecfg,
+             gang_args) = abstract_cycle_args(d, gang=gang)
+            _schedule_batch_impl.lower(
+                tables, pending, keys, d.D, existing, engine, hw, ecfg,
+                extras, tuple(1.0 for _ in extras), gang_args,
+            ).compile()
+            self.warm_log.append((d, engine))
+        except Exception:
+            # prewarming is an optimization: a failed background compile
+            # must never take down the scheduling loop; the live path will
+            # compile on demand exactly as without a prewarmer
+            with self._mu:
+                self._warmed.discard(
+                    (replace(d, has_node_name=False), engine, extras, gang))
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Test/shutdown helper: join the in-flight compile."""
+        t = self._inflight
+        if t is not None:
+            t.join(timeout)

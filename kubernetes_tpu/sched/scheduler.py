@@ -136,6 +136,12 @@ class Scheduler:
         # key → (attempts, CycleState, node_name, original pod, binder_ext)
         self._waiting_meta: Dict[str, Tuple] = {}
         self.waiting_bind_errors = 0  # bind failures on the waiting-release path
+        # compile-ahead on capacity growth (sched/prewarm.py): the next
+        # Dims bucket compiles in the background BEFORE occupancy crosses
+        # it, so bucket growth never stalls the scheduling loop
+        from .prewarm import BucketPrewarmer
+
+        self.prewarmer = BucketPrewarmer()
 
     # ------------------------------------------------------------------ #
     # event handlers (eventhandlers.go)
@@ -242,6 +248,14 @@ class Scheduler:
         pending = [p for p, _ in batch]
         snap, keys = self._snapshot_keys(pending)
         extras = tuple(p for p, _ in self._extra_score)
+        from .cycle import _engine
+
+        self.prewarmer.observe(
+            snap.dims, n_nodes=self.cache.node_count,
+            n_existing=self.cache.pod_count,
+            engine="scan" if snap.dims.has_node_name else _engine(),
+            extras=extras,
+            gang=self._device_gangs and snap.gang is not None)
         res = _schedule_batch(snap.tables, snap.pending, keys, snap.dims.D,
                               snap.existing,
                               has_node_name=snap.dims.has_node_name,

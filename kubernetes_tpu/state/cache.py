@@ -165,6 +165,16 @@ class SchedulerCache:
             else:
                 self._group_bound.pop(gk, None)
 
+    @property
+    def node_count(self) -> int:
+        with self._mu:
+            return len(self._nodes)
+
+    @property
+    def pod_count(self) -> int:
+        with self._mu:
+            return len(self._pods)
+
     def group_bound_count(self, group_key: str) -> int:
         """Bound/assumed members of a gang group (the Coscheduling plugin's
         quorum source — assumed-but-waiting members count, exactly the set

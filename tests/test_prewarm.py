@@ -1,0 +1,207 @@
+"""Compile-ahead on capacity-bucket growth (sched/prewarm.py).
+
+The cold-compile cliff: crossing a Dims bucket recompiles the cycle program
+(minutes at 2k+ nodes on a cold cache). The prewarmer must (a) build
+abstract arguments whose shapes/pytree structure EXACTLY match the live
+call — the fragile part, guarded here by actually compiling through the
+production jit function — and (b) fire at the right occupancy, once per
+signature, without ever blocking the scheduling loop.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import Node, Pod, Resources
+from kubernetes_tpu.sched.prewarm import BucketPrewarmer, abstract_cycle_args
+from kubernetes_tpu.state.dims import Dims
+
+
+def mknode(i, cpu="8"):
+    return Node(name=f"n{i}",
+                allocatable=Resources.make(cpu=cpu, memory="16Gi", pods=110))
+
+
+class TestAbstractCompile:
+    def test_abstract_args_compile_through_production_jit(self):
+        """AOT-compiling from abstract shapes must succeed through
+        _schedule_batch_impl itself — if the abstract pytree ever drifts
+        from the live call's structure, this is the test that breaks."""
+        from kubernetes_tpu.sched.cycle import _schedule_batch_impl
+
+        d = Dims().grown_for(N=16, P=16, E=16)
+        (tables, pending, keys, existing, hw, ecfg,
+         gang) = abstract_cycle_args(d)
+        compiled = _schedule_batch_impl.lower(
+            tables, pending, keys, d.D, existing, "waves", hw, ecfg,
+            (), (), gang).compile()
+        assert compiled is not None
+
+    def test_abstract_gang_args_compile_through_production_jit(self):
+        """The gang-bearing trace (restart loop) must AOT-compile too —
+        gang clusters cross buckets like any other."""
+        from kubernetes_tpu.sched.cycle import _schedule_batch_impl
+
+        d = Dims().grown_for(N=16, P=16, E=16, GR=8)
+        (tables, pending, keys, existing, hw, ecfg,
+         gang) = abstract_cycle_args(d, gang=True)
+        assert gang is not None
+        compiled = _schedule_batch_impl.lower(
+            tables, pending, keys, d.D, existing, "waves", hw, ecfg,
+            (), (), gang).compile()
+        assert compiled is not None
+
+    def test_prewarmed_signature_matches_live_call(self):
+        """After warming dims d, a LIVE call at exactly d must hit the jit
+        shape signature the warm built (same Dims → same array shapes)."""
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.sched.cycle import (
+            UNSCHEDULABLE_TAINT_KEY, _schedule_batch)
+        from kubernetes_tpu.state.encode import Encoder
+
+        nodes = [mknode(i) for i in range(4)]
+        pods = [Pod(name=f"p{i}", requests=Resources.make(cpu="1"),
+                    creation_index=i) for i in range(4)]
+        enc = Encoder()
+        enc.vocabs.label_keys.intern(UNSCHEDULABLE_TAINT_KEY)
+        enc.vocabs.label_vals.intern("")
+        tables, ex, pe, d = enc.encode_cluster(nodes, [], pods, None)
+        warm_args = abstract_cycle_args(d)
+        live_shapes = [(a.shape, str(a.dtype))
+                       for a in __import__("jax").tree.leaves(
+                           (tables, pe, ex))]
+        warm_shapes = [(a.shape, str(a.dtype))
+                       for a in __import__("jax").tree.leaves(
+                           (warm_args[0], warm_args[1], warm_args[3]))]
+        assert warm_shapes == live_shapes
+
+
+class TestTriggerPolicy:
+    def _spy(self):
+        calls = []
+        ev = threading.Event()
+
+        def fake_compile(d, engine, extras, gang):
+            calls.append((d, engine, gang))
+            ev.set()
+        return calls, ev, fake_compile
+
+    def test_fires_at_threshold_once_per_signature(self):
+        calls, ev, fake = self._spy()
+        pw = BucketPrewarmer(threshold=0.8, min_axis=8, compile_fn=fake)
+        d = Dims().grown_for(N=16, E=16)
+        pw.observe(d, n_nodes=4, n_existing=4)     # 25% — quiet
+        assert not calls
+        pw.observe(d, n_nodes=13, n_existing=4)    # 81% of N → fire
+        assert ev.wait(5)
+        pw.wait(5)
+        assert len(calls) == 1
+        target = calls[0][0]
+        assert target.N > d.N                       # the NEXT bucket
+        pw.observe(d, n_nodes=14, n_existing=4)    # same signature → no refire
+        pw.wait(5)
+        assert len(calls) == 1
+
+    def test_multi_axis_crossing_warms_each_target(self):
+        """Both axes near their boundary: successive cycles warm the N-only,
+        E-only, AND joint targets — whichever the live path crosses first is
+        covered (single compile in flight at a time)."""
+        calls, _, fake = self._spy()
+        pw = BucketPrewarmer(threshold=0.8, min_axis=8, compile_fn=fake)
+        d = Dims().grown_for(N=16, E=16)
+        for _ in range(5):
+            pw.observe(d, n_nodes=14, n_existing=14)
+            pw.wait(5)
+        warmed = {(c[0].N, c[0].E) for c in calls}
+        assert (32, 16) in warmed    # N-only
+        assert (16, 32) in warmed    # E-only
+        assert (32, 32) in warmed    # joint
+
+    def test_gang_traces_warm_separately(self):
+        """gang=True is part of the warmed key: a gang-bearing cluster warms
+        the restart-loop trace, not (only) the plain one."""
+        calls, ev, fake = self._spy()
+        pw = BucketPrewarmer(threshold=0.8, min_axis=8, compile_fn=fake)
+        d = Dims().grown_for(N=16)
+        pw.observe(d, n_nodes=14, n_existing=1, gang=True)
+        assert ev.wait(5)
+        pw.wait(5)
+        assert calls and calls[0][2] is True
+        # same dims, plain trace → a separate warm
+        pw.observe(d, n_nodes=14, n_existing=1, gang=False)
+        pw.wait(5)
+        assert len(calls) == 2 and calls[1][2] is False
+
+    def test_small_axes_never_warm(self):
+        calls, _, fake = self._spy()
+        pw = BucketPrewarmer(threshold=0.8, min_axis=256, compile_fn=fake)
+        d = Dims().grown_for(N=16, E=16)
+        pw.observe(d, n_nodes=16, n_existing=16)   # 100% but tiny
+        pw.wait(1)
+        assert not calls
+
+    def test_existing_axis_growth_fires(self):
+        calls, ev, fake = self._spy()
+        pw = BucketPrewarmer(threshold=0.8, min_axis=8, compile_fn=fake)
+        d = Dims().grown_for(N=16, E=32)
+        pw.observe(d, n_nodes=2, n_existing=30)    # 94% of E
+        assert ev.wait(5)
+        pw.wait(5)
+        assert calls and calls[0][0].E > d.E
+
+    def test_failed_compile_clears_ledger_for_retry(self, monkeypatch):
+        """A background compile failure must never propagate AND must clear
+        the warmed ledger so a later cycle can retry."""
+        import kubernetes_tpu.sched.prewarm as pm
+
+        def boom(*a, **k):
+            raise RuntimeError("compile backend down")
+
+        monkeypatch.setattr(pm, "abstract_cycle_args", boom)
+        pw = BucketPrewarmer(threshold=0.8, min_axis=8)
+        pw.observe(Dims().grown_for(N=16), n_nodes=13, n_existing=1)
+        pw.wait(10)
+        assert not pw._warmed  # failure → signature eligible for retry
+
+
+class TestGrowthAcrossBucketBoundary:
+    def test_cycles_keep_running_while_cluster_grows(self):
+        """The VERDICT scenario: node count grows across a Dims bucket
+        boundary while waves keep scheduling. The prewarmer must have been
+        asked for the next bucket BEFORE the boundary was crossed, and
+        every cycle must keep placing pods (no failed cycles, no stalls
+        waiting on anything but the ordinary dispatch)."""
+        from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+
+        calls = []
+
+        binder = RecordingBinder()
+        s = Scheduler(binder=binder, base_dims=Dims().grown_for(N=16, E=16))
+        s.prewarmer = BucketPrewarmer(
+            threshold=0.8, min_axis=8,
+            compile_fn=lambda d, e, x, g: calls.append(d))
+
+        for i in range(8):
+            s.on_node_add(mknode(i))
+        pod_i = 0
+
+        def feed(k):
+            nonlocal pod_i
+            for _ in range(k):
+                s.on_pod_add(Pod(name=f"p{pod_i}",
+                                 requests=Resources.make(cpu="100m"),
+                                 creation_index=pod_i))
+                pod_i += 1
+
+        # grow 8 → 24 nodes (crosses the N=16 bucket), scheduling each step
+        for n in range(8, 24):
+            s.on_node_add(mknode(n))
+            feed(2)
+            stats = s.schedule_pending()
+            assert stats.scheduled == 2, f"stall at {n + 1} nodes"
+        s.prewarmer.wait(5)
+        assert calls, "prewarmer never fired while growing to the boundary"
+        assert any(d.N > 16 for d in calls)
+        assert len(binder.bound) == pod_i
