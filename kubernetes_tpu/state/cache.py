@@ -446,8 +446,6 @@ class SchedulerCache:
                 or self._encoder is not encoder
                 or replace(d, has_node_name=False)
                 != replace(snap.dims, has_node_name=False)
-                # a new topology key adds a column to EVERY node row
-                or len(encoder.vocabs.topo_keys) != self._n_topo_keys
             )
             if full:
                 return self._full_snapshot(encoder, pending, pending_keys,
@@ -586,6 +584,34 @@ class SchedulerCache:
         self.last_snapshot_mode = "patch"
         from .dims import bucket
 
+        # --- new topology keys: backfill only the new [N] topo column(s) ---
+        # A never-seen topologyKey used to force the ~full-encode fallback
+        # (every node row owns a cell in the [N, K] topo plane). As long as
+        # the key fits the existing K/D capacities (Dims unchanged — the
+        # caller already checked), the column is a pure function of node
+        # labels the staging mirror already holds: derive it host-side in
+        # O(N·new_keys) dict lookups and ship the 4·N·K-byte plane, keeping
+        # an adversarial label stream on the patch path.
+        nk = len(encoder.vocabs.topo_keys)
+        topo_grew = nk != self._n_topo_keys
+        if topo_grew:
+            for ki in range(self._n_topo_keys, nk):
+                key = encoder.vocabs.topo_keys.lookup(ki)
+                dm = (encoder.domain_maps[ki]
+                      if ki < len(encoder.domain_maps) else {})
+                for slot, nm in enumerate(self._node_names):
+                    n = self._nodes.get(nm)
+                    val = n.labels.get(key) if n is not None else None
+                    if val is None:
+                        continue
+                    vid = encoder.vocabs.label_vals.get(val)
+                    # both planes, exactly as encode_node_row writes them:
+                    # `topo` (label-value id) and `domain` (compact domain id
+                    # — what interpod/topospread kernels actually read)
+                    self._staging_nodes.topo[slot, ki] = vid
+                    self._staging_nodes.domain[slot, ki] = dm.get(vid, -1)
+            self._n_topo_keys = nk
+
         # --- node rows (removed nodes were already cleared in snapshot()) ---
         node_idx: List[int] = list(released_nodes)
         for name in sorted(self._dirty_nodes):
@@ -599,6 +625,14 @@ class SchedulerCache:
             node_idx.append(slot)
 
         tables = snap.tables
+        if topo_grew:
+            tables = tables._replace(
+                nodes=tables.nodes._replace(
+                    topo=jax.device_put(
+                        np.ascontiguousarray(self._staging_nodes.topo)),
+                    domain=jax.device_put(
+                        np.ascontiguousarray(self._staging_nodes.domain))),
+                zone_keys=jax.device_put(encoder.build_zone_keys()))
         if node_idx:
             kb = bucket(len(node_idx))
             idx = _pad_patch(node_idx, kb)
