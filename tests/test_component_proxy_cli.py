@@ -87,7 +87,9 @@ class TestFeatureGates:
                 "apiVersion": "v1", "kind": "Pod",
                 "metadata": {"name": "m", "namespace": "default"},
                 "spec": {"containers": [{"name": "c", "image": "i"}]}})
-            deadline = time.monotonic() + 15
+            # generous: a cold persistent-compile-cache run pays the full
+            # wave-engine XLA compile (~20s on the CPU backend) here
+            deadline = time.monotonic() + 90
             while time.monotonic() < deadline:
                 if client.pods.get("m")["spec"].get("nodeName"):
                     break

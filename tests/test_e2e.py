@@ -126,10 +126,19 @@ class TestEndToEnd:
 
     def test_node_affinity_respected_e2e(self, cluster):
         client, hollow, sched, cm = cluster
-        # label one hollow node; require it via nodeAffinity
-        node = client.nodes.get("hollow-node-1", "")
-        node["metadata"].setdefault("labels", {})["disk"] = "ssd"
-        client.nodes.update(node, "")
+        # label one hollow node; require it via nodeAffinity (CAS-retry: the
+        # kubelet heartbeat updates the node concurrently)
+        for _ in range(20):
+            node = client.nodes.get("hollow-node-1", "")
+            node["metadata"].setdefault("labels", {})["disk"] = "ssd"
+            try:
+                client.nodes.update(node, "")
+                break
+            except errors.StatusError as e:
+                if not errors.is_conflict(e):
+                    raise
+        else:
+            pytest.fail("could not label node after 20 CAS attempts")
         client.pods.create({
             "apiVersion": "v1", "kind": "Pod",
             "metadata": {"name": "pinned", "namespace": "default"},
