@@ -252,6 +252,10 @@ def build_scheme() -> Scheme:
     # ---- coordination (leader-election leases) ----
     s.register(R("coordination.k8s.io", "v1", "Lease", "leases"))
 
+    # ---- discovery (EndpointSlice, v1beta1 at the reference's vintage) ----
+    s.register(R("discovery.k8s.io", "v1beta1", "EndpointSlice",
+                 "endpointslices"))
+
     # --- admission webhooks (admissionregistration.k8s.io) ---
     s.register(R("admissionregistration.k8s.io", "v1",
                  "MutatingWebhookConfiguration",

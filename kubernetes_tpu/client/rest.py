@@ -46,13 +46,17 @@ class LocalTransport:
 
 
 class HTTPTransport:
-    """The wire path: JSON REST + line-delimited chunked watch streams."""
+    """The wire path: REST + chunked watch streams. `binary=True` opts the
+    client into the negotiated binary codec (machinery/codec.py — the
+    `application/vnd.kubernetes.protobuf` seat every internal reference
+    client takes, protobuf.go); JSON stays the default and the fallback."""
 
     def __init__(self, base_url: str, timeout: float = 30.0,
-                 token: str = ""):
+                 token: str = "", binary: bool = False):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
+        self.binary = binary
 
     def _url(self, path: str, query: Dict[str, str]) -> str:
         url = self.base_url + path
@@ -60,29 +64,45 @@ class HTTPTransport:
             url += "?" + urllib.parse.urlencode(query)
         return url
 
-    def request(self, method: str, path: str, query: Dict[str, str],
-                body: Optional[Obj]) -> Obj:
-        req = urllib.request.Request(self._url(path, query), method=method)
-        data = None
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
-        if body is not None:
-            data = json.dumps(body).encode()
-            req.add_header("Content-Type", "application/json")
-        try:
-            with urllib.request.urlopen(req, data=data,
-                                        timeout=self.timeout) as r:
-                raw = r.read()
-        except urllib.error.HTTPError as e:
-            try:
-                status = json.loads(e.read())
-            except Exception:  # noqa: BLE001
-                raise errors.StatusError(e.code, "Unknown", str(e))
-            raise errors.from_status(status)
+    def _decode_body(self, raw: bytes, content_type: str) -> Obj:
+        from kubernetes_tpu.machinery import codec
+
+        if content_type.split(";")[0] == codec.BINARY_MEDIA_TYPE:
+            return codec.decode(raw)
         try:
             return json.loads(raw)
         except json.JSONDecodeError:
             return {"raw": raw.decode(errors="replace")}
+
+    def request(self, method: str, path: str, query: Dict[str, str],
+                body: Optional[Obj]) -> Obj:
+        from kubernetes_tpu.machinery import codec
+
+        req = urllib.request.Request(self._url(path, query), method=method)
+        data = None
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        if self.binary:
+            req.add_header("Accept", codec.BINARY_MEDIA_TYPE)
+        if body is not None:
+            if self.binary:
+                data = codec.encode(body)
+                req.add_header("Content-Type", codec.BINARY_MEDIA_TYPE)
+            else:
+                data = json.dumps(body).encode()
+                req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, data=data,
+                                        timeout=self.timeout) as r:
+                return self._decode_body(
+                    r.read(), r.headers.get("Content-Type", ""))
+        except urllib.error.HTTPError as e:
+            try:
+                status = self._decode_body(
+                    e.read(), e.headers.get("Content-Type", ""))
+            except Exception:  # noqa: BLE001
+                raise errors.StatusError(e.code, "Unknown", str(e))
+            raise errors.from_status(status)
 
     def stream_watch(self, path: str, query: Dict[str, str]) -> mwatch.Watch:
         q = dict(query)
@@ -90,20 +110,44 @@ class HTTPTransport:
         q.setdefault("timeoutSeconds", "3600")
         w = mwatch.Watch(capacity=8192)
 
+        def pump_json(r) -> None:
+            for raw_line in r:
+                if w.stopped:
+                    return
+                line = raw_line.strip()
+                if not line:
+                    continue
+                ev = json.loads(line)
+                w.send(mwatch.Event(ev["type"], ev["object"]))
+
+        def pump_binary(r) -> None:
+            from kubernetes_tpu.machinery import codec
+
+            buf = b""
+            while not w.stopped:
+                chunk = r.read1(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                events, buf = codec.decode_frames(buf)
+                for ev in events:
+                    w.send(mwatch.Event(ev["type"], ev["object"]))
+
         def pump() -> None:
+            from kubernetes_tpu.machinery import codec
+
             try:
                 req = urllib.request.Request(self._url(path, q))
                 if self.token:
                     req.add_header("Authorization", f"Bearer {self.token}")
+                if self.binary:
+                    req.add_header("Accept", codec.BINARY_MEDIA_TYPE)
                 with urllib.request.urlopen(req, timeout=self.timeout + 3600) as r:
-                    for raw_line in r:
-                        if w.stopped:
-                            return
-                        line = raw_line.strip()
-                        if not line:
-                            continue
-                        ev = json.loads(line)
-                        w.send(mwatch.Event(ev["type"], ev["object"]))
+                    ctype = (r.headers.get("Content-Type") or "").split(";")[0]
+                    if ctype == codec.BINARY_MEDIA_TYPE:
+                        pump_binary(r)
+                    else:
+                        pump_json(r)
             except Exception:  # noqa: BLE001 — stream teardown
                 pass
             finally:
@@ -256,6 +300,7 @@ _KNOWN = {
     "cronjobs": ("batch", "v1beta1", "cronjobs", True),
     "poddisruptionbudgets": ("policy", "v1beta1", "poddisruptionbudgets", True),
     "leases": ("coordination.k8s.io", "v1", "leases", True),
+    "endpointslices": ("discovery.k8s.io", "v1beta1", "endpointslices", True),
     "horizontalpodautoscalers": ("autoscaling", "v1",
                                  "horizontalpodautoscalers", True),
     "storageclasses": ("storage.k8s.io", "v1", "storageclasses", False),
@@ -278,8 +323,10 @@ class Client:
         return Client(LocalTransport(api))
 
     @staticmethod
-    def http(base_url: str, token: str = "") -> "Client":
-        return Client(HTTPTransport(base_url, token=token))
+    def http(base_url: str, token: str = "", binary: bool = False) -> "Client":
+        """`binary=True` negotiates the binary codec for every request and
+        watch stream — the internal-client configuration (protobuf.go)."""
+        return Client(HTTPTransport(base_url, token=token, binary=binary))
 
     def resource(self, group: str, version: str, resource: str,
                  namespaced: bool = True) -> ResourceClient:

@@ -12,6 +12,7 @@ from kubernetes_tpu.controllers.base import (
 )
 from kubernetes_tpu.controllers.infra import (
     DisruptionController,
+    EndpointSliceController,
     EndpointsController,
     GarbageCollector,
     NamespaceController,
@@ -38,7 +39,8 @@ from kubernetes_tpu.controllers.workloads import (
 __all__ = [
     "Controller", "ControllerManager", "CronJobController",
     "DaemonSetController", "DEFAULT_CONTROLLERS", "DeploymentController",
-    "DisruptionController", "EndpointsController", "GarbageCollector",
+    "DisruptionController", "EndpointSliceController", "EndpointsController",
+    "GarbageCollector",
     "JobController", "NamespaceController", "NodeLifecycleController",
     "PodGCController", "ReplicaSetController", "ResourceQuotaController",
     "StatefulSetController", "TAINT_NOT_READY", "TAINT_UNREACHABLE",

@@ -20,6 +20,7 @@ from kubernetes_tpu.client.leaderelection import (
 from kubernetes_tpu.controllers.base import Controller
 from kubernetes_tpu.controllers.infra import (
     DisruptionController,
+    EndpointSliceController,
     EndpointsController,
     GarbageCollector,
     NamespaceController,
@@ -52,6 +53,7 @@ DEFAULT_CONTROLLERS: Dict[str, Callable] = {
     "job": JobController,
     "cronjob": CronJobController,
     "endpoints": EndpointsController,
+    "endpointslice": EndpointSliceController,
     "nodelifecycle": NodeLifecycleController,
     "namespace": NamespaceController,
     "garbagecollector": GarbageCollector,

@@ -255,6 +255,72 @@ class TestEndpointsAndServices:
         assert ep["subsets"][0]["ports"][0]["port"] == 8080
 
 
+class TestEndpointSlices:
+    """pkg/controller/endpointslice: Service → set of ≤max-size slices."""
+
+    def _mk_service(self, client, name="sliced", ports=None):
+        client.services.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"selector": {"app": name},
+                     "ports": ports or [{"port": 80, "targetPort": 8080}]}})
+
+    def _mk_pods(self, client, n, app="sliced"):
+        for i in range(n):
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"{app}-{i}", "namespace": "default",
+                             "labels": {"app": app}},
+                "spec": {"containers": [{"name": "c"}], "nodeName": "n1"}})
+        mark_pods_running(client, selector=f"app={app}")
+
+    def _owned(self, client, svc):
+        return [s for s in client.endpointslices.list("default")["items"]
+                if s["metadata"]["labels"]
+                .get("kubernetes.io/service-name") == svc]
+
+    def test_endpoints_split_across_slices(self, client, api):
+        cm = ControllerManager(client, controllers=["endpointslice"],
+                               poll_interval=0.2).start()
+        try:
+            # tiny max for the test (2 endpoints per slice → 3 slices), set
+            # BEFORE any service/pod event can trigger a sync at the default
+            cm.controllers["endpointslice"].max_per_slice = 2
+            self._mk_service(client)
+            self._mk_pods(client, 5)
+            assert wait_for(lambda: len(self._owned(client, "sliced")) == 3)
+            slices = self._owned(client, "sliced")
+            assert all(len(s["endpoints"]) <= 2 for s in slices)
+            ips = sorted(ep["addresses"][0] for s in slices
+                         for ep in s["endpoints"])
+            assert len(ips) == 5 and len(set(ips)) == 5
+            assert all(s["addressType"] == "IPv4" for s in slices)
+            assert all(s["ports"][0]["port"] == 8080 for s in slices)
+            assert all(s["metadata"]["ownerReferences"][0]["name"] == "sliced"
+                       for s in slices)
+            # pod goes away → endpoint leaves its slice, surplus slice GC'd
+            client.pods.delete("sliced-4", "default")
+            assert wait_for(lambda: sum(
+                len(s["endpoints"]) for s in self._owned(client, "sliced"))
+                == 4)
+            assert len(self._owned(client, "sliced")) == 2
+        finally:
+            cm.stop()
+
+    def test_service_delete_collects_slices(self, client, api):
+        cm = ControllerManager(client, controllers=["endpointslice"],
+                               poll_interval=0.2).start()
+        try:
+            self._mk_service(client)
+            self._mk_pods(client, 2)
+            assert wait_for(lambda: self._owned(client, "sliced"))
+            client.services.delete("sliced", "default")
+            assert wait_for(
+                lambda: not self._owned(client, "sliced"))
+        finally:
+            cm.stop()
+
+
 class TestNamespaceLifecycle:
     def test_terminating_namespace_sweeps_content(self, client, api, cm):
         client.namespaces.create({"apiVersion": "v1", "kind": "Namespace",
