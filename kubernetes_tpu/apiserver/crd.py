@@ -1,19 +1,109 @@
-"""CustomResourceDefinitions: dynamic resource registration + validation.
+"""CustomResourceDefinitions: dynamic registration, validation, conversion.
 
 Analog of `staging/src/k8s.io/apiextensions-apiserver`: a CRD object
 registers a new served resource at /apis/{group}/{version}/{plural} with
 structural-schema validation (the openAPIV3Schema subset that carries:
 type, properties, required, enum, minimum/maximum, items).
-"""
+
+Multi-version CRDs convert through `spec.conversion`
+(pkg/apiserver/conversion/converter.go): objects persist at the single
+`storage: true` version; serving another `served` version converts on the
+way out (and request bodies on the way in). Strategy `None` rewrites
+apiVersion only; strategy `Webhook` POSTs a ConversionReview
+{request: {uid, desiredAPIVersion, objects}} to the configured client and
+uses response.convertedObjects — the same wire contract as
+conversion/webhook_converter.go, carried by the round-3 webhook transport
+(apiserver/webhooks.py `_call_webhook`, so tests can register in-process
+converters)."""
 
 from __future__ import annotations
 
+import uuid
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
-from kubernetes_tpu.machinery import meta
+from kubernetes_tpu.machinery import errors, meta
 from kubernetes_tpu.machinery.scheme import ResourceInfo
 
 Obj = Dict[str, Any]
+
+
+@dataclass
+class ConversionEntry:
+    """One multi-version CRD's conversion wiring (converter.go's
+    crConverter, flattened)."""
+
+    group: str
+    plural: str
+    served: List[str]        # every served version
+    storage: str             # the persisted version
+    strategy: str            # "None" | "Webhook"
+    webhook_url: str = ""
+    timeout: float = 10.0
+
+    def convert(self, objs: List[Obj], desired_version: str) -> List[Obj]:
+        if not objs:
+            return []
+        apiv = f"{self.group}/{desired_version}"
+        if self.strategy != "Webhook":
+            out = []
+            for o in objs:
+                c = meta.deep_copy(o)
+                c["apiVersion"] = apiv
+                out.append(c)
+            return out
+        from kubernetes_tpu.apiserver.webhooks import _call_webhook
+
+        review = {
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "ConversionReview",
+            "request": {"uid": uuid.uuid4().hex,
+                        "desiredAPIVersion": apiv,
+                        "objects": objs},
+        }
+        try:
+            out = _call_webhook(self.webhook_url, review, self.timeout)
+        except Exception as e:  # noqa: BLE001 — converter down = 500
+            raise errors.StatusError(
+                500, "InternalError",
+                f"conversion webhook for {self.group}/{self.plural} "
+                f"failed: {e}")
+        resp = (out or {}).get("response", {}) or {}
+        if (resp.get("result", {}) or {}).get("status") != "Success":
+            msg = (resp.get("result", {}) or {}).get(
+                "message", "conversion webhook refused the objects")
+            raise errors.StatusError(500, "InternalError", msg)
+        conv = resp.get("convertedObjects") or []
+        if len(conv) != len(objs):
+            raise errors.StatusError(
+                500, "InternalError",
+                "conversion webhook returned the wrong object count")
+        for c in conv:
+            c["apiVersion"] = apiv
+        return conv
+
+
+def conversion_entry_from_crd(crd: Obj) -> Optional[ConversionEntry]:
+    """Multi-version conversion wiring, or None for single-version CRDs."""
+    spec = crd.get("spec", {})
+    versions = spec.get("versions") or []
+    served = [v.get("name", "") for v in versions if v.get("served", True)]
+    if len(served) < 2:
+        return None
+    storage = next((v.get("name", "") for v in versions
+                    if v.get("storage") and v.get("served", True)), served[0])
+    conv = spec.get("conversion") or {}
+    strategy = conv.get("strategy", "None")
+    url = ""
+    if strategy == "Webhook":
+        url = ((conv.get("webhook") or {}).get("clientConfig") or
+               conv.get("webhookClientConfig") or {}).get("url", "")
+    return ConversionEntry(
+        group=spec.get("group", ""),
+        plural=(spec.get("names") or {}).get("plural", ""),
+        served=served, storage=storage, strategy=strategy,
+        webhook_url=url,
+        timeout=float(conv.get("timeoutSeconds", 10)))
 
 
 def validate_against_schema(value: Any, schema: Dict[str, Any],
@@ -66,7 +156,14 @@ def resource_info_from_crd(crd: Obj) -> Optional[ResourceInfo]:
     plural = names.get("plural", "")
     kind = names.get("kind", "")
     versions = spec.get("versions") or []
-    served = next((v for v in versions if v.get("served", True)), None)
+    # multi-version: objects persist (and validate) at the storage version
+    # when it is served; other served versions route through the
+    # ConversionEntry. A served:false storage version (legal mid-migration)
+    # must NOT be registered as the serving version — fall back to the
+    # first served one (deviation: persistence then happens there too).
+    served = next((v for v in versions
+                   if v.get("storage") and v.get("served", True)), None) \
+        or next((v for v in versions if v.get("served", True)), None)
     if not (group and plural and kind and served):
         return None
     schema = ((served.get("schema") or {}).get("openAPIV3Schema")
@@ -106,6 +203,11 @@ def install_crd_hook(api) -> None:
         info = resource_info_from_crd(crd)
         if info is not None:
             api.register_resource(info)
+            entry = conversion_entry_from_crd(crd)
+            if entry is not None:
+                api.crd_conversions[(info.group, info.resource)] = entry
+            else:
+                api.crd_conversions.pop((info.group, info.resource), None)
             # mark Established, as the apiextensions status controller does
             def establish(o: Obj) -> Obj:
                 conds = o.setdefault("status", {}).setdefault("conditions", [])
@@ -124,12 +226,11 @@ def install_crd_hook(api) -> None:
         info = resource_info_from_crd(crd)
         if info is not None:
             api.unregister_resource(info.group, info.resource)
+            api.crd_conversions.pop((info.group, info.resource), None)
 
     def reregister(crd: Obj) -> None:
-        # update path: a changed schema replaces the validator immediately
-        info = resource_info_from_crd(crd)
-        if info is not None:
-            api.register_resource(info)
+        # update path: a changed schema/conversion replaces both immediately
+        register(crd)
 
     store.after_create = register
     store.after_update = reregister

@@ -71,6 +71,9 @@ class APIServer:
         self._stores: Dict[Tuple[str, str], Store] = {}
         for info in self.scheme.resources():
             self._install(info)
+        # multi-version CRD conversion wiring: (group, plural) → entry
+        # (apiextensions conversion/converter.go; see apiserver/crd.py)
+        self.crd_conversions: Dict[Tuple[str, str], Any] = {}
         # namespace bookkeeping: ensure default namespaces exist
         for ns in ("default", "kube-system", "kube-public", "kube-node-lease"):
             try:
@@ -268,9 +271,13 @@ class APIServer:
         groups: Dict[str, List[str]] = {}
         for info in self.scheme.resources():
             if info.group:
+                entry = self.crd_conversions.get((info.group, info.resource))
+                versions = list(entry.served) if entry is not None \
+                    else [info.version]
                 groups.setdefault(info.group, [])
-                if info.version not in groups[info.group]:
-                    groups[info.group].append(info.version)
+                for v in versions:
+                    if v not in groups[info.group]:
+                        groups[info.group].append(v)
         return {"kind": "APIGroupList", "apiVersion": "v1", "groups": [
             {"name": g, "versions": [
                 {"groupVersion": f"{g}/{v}", "version": v} for v in vs],
@@ -281,6 +288,18 @@ class APIServer:
     def discovery_resources(self, group: str, version: str) -> Obj:
         out = []
         for info in self.scheme.resources():
+            # a multi-version CRD is discoverable at every served version,
+            # not only the storage version its ResourceInfo registers
+            entry = self.crd_conversions.get((info.group, info.resource))
+            if entry is not None and info.group == group \
+                    and version in entry.served and version != info.version:
+                out.append({"name": info.resource, "kind": info.kind,
+                            "namespaced": info.namespaced,
+                            "shortNames": list(info.short_names),
+                            "verbs": ["create", "delete", "deletecollection",
+                                      "get", "list", "patch", "update",
+                                      "watch"]})
+                continue
             if info.group == group and info.version == version:
                 out.append({"name": info.resource, "kind": info.kind,
                             "namespaced": info.namespaced,
@@ -306,12 +325,87 @@ _AUDIT_VERBS = {"POST": "create", "PUT": "update", "PATCH": "patch",
                 "DELETE": "delete"}
 
 
+class _ConvertingWatch:
+    """Wraps a Watch, converting every event's object to the requested CRD
+    version on delivery — what makes `watch sees converted objects` true for
+    multi-version CRDs (conversion/converter.go applied to the watch path)."""
+
+    def __init__(self, w: mwatch.Watch, fn: Callable[[Obj], Obj]):
+        self._w = w
+        self._fn = fn
+
+    def next(self, timeout: Optional[float] = None):
+        ev = self._w.next(timeout=timeout)
+        if ev is None:
+            return None
+        if ev.type not in (mwatch.ADDED, mwatch.MODIFIED, mwatch.DELETED):
+            # ERROR (e.g. the 410 Gone relist signal) and BOOKMARK carry
+            # Status/bookmark payloads, not CR objects — never converted
+            return ev
+        try:
+            return mwatch.Event(ev.type, self._fn(ev.object))
+        except errors.StatusError:
+            # converter failure mid-stream: terminate like a slow watcher
+            self._w.stop()
+            return None
+
+    def stop(self) -> None:
+        self._w.stop()
+
+    @property
+    def stopped(self) -> bool:
+        return self._w.stopped
+
+
+def _conversion_for(api: APIServer, path: str):
+    """(entry, wanted_version) when `path` addresses a multi-version CRD at
+    a non-storage served version; (None, "") otherwise."""
+    parts = [p for p in path.split("/") if p]
+    if len(parts) < 4 or parts[0] != "apis":
+        return None, ""
+    group, want = parts[1], parts[2]
+    rest = parts[3:]
+    if rest[0] == "namespaces" and len(rest) >= 3:
+        rest = rest[2:]
+    entry = api.crd_conversions.get((group, rest[0]))
+    if entry is None or want == entry.storage or want not in entry.served:
+        return None, ""
+    return entry, want
+
+
 def handle_rest(api: APIServer, method: str, path: str,
                 query: Dict[str, str], body: Optional[Obj], user: str = ""):
     """Route one REST request. Returns (code, obj) or ("WATCH", Watch).
-    Mutations are audited at this chokepoint (stage ResponseComplete), both
-    outcomes — the reference's audit filter sits in the same position in the
-    handler chain."""
+    Multi-version CRD requests convert at this chokepoint: bodies from the
+    requested version to the storage version, results back (lists per item,
+    watches per event). Mutations are audited here too (stage
+    ResponseComplete, both outcomes) — the reference's audit filter sits in
+    the same position in the handler chain."""
+    entry = None
+    if api.crd_conversions:
+        entry, want = _conversion_for(api, path)
+    if entry is not None and isinstance(body, dict) and \
+            method in ("POST", "PUT"):
+        body = entry.convert([body], entry.storage)[0]
+    out = _handle_rest_audited(api, method, path, query, body, user)
+    if entry is None:
+        return out
+    tag, obj = out
+    if tag == "WATCH":
+        return "WATCH", _ConvertingWatch(
+            obj, lambda o: entry.convert([o], want)[0])
+    if isinstance(obj, dict):
+        if isinstance(obj.get("items"), list):
+            obj = {**obj, "apiVersion": f"{entry.group}/{want}",
+                   "items": entry.convert(obj["items"], want)}
+        elif obj.get("kind") != "Status" and "metadata" in obj:
+            obj = entry.convert([obj], want)[0]
+    return tag, obj
+
+
+def _handle_rest_audited(api: APIServer, method: str, path: str,
+                         query: Dict[str, str], body: Optional[Obj],
+                         user: str = ""):
     if method not in _AUDIT_VERBS:
         return _handle_rest_inner(api, method, path, query, body)
     body_name = meta.name(body) if isinstance(body, dict) else ""
@@ -480,17 +574,28 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _run(self, method: str) -> None:
+        from kubernetes_tpu.machinery import codec
+
         api: APIServer = self.server.api  # type: ignore[attr-defined]
         auth_gate = getattr(self.server, "auth_gate", None)
         parsed = urlparse(self.path)
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        # content negotiation (protobuf.go analog, machinery/codec.py):
+        # binary replies only when the client Accepts them; binary bodies
+        # recognized by Content-Type
+        self._binary_reply = codec.accepts_binary(
+            self.headers.get("Accept", ""))
         body: Optional[Obj] = None
         length = int(self.headers.get("Content-Length") or 0)
         if length:
+            raw = self.rfile.read(length)
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0]
             try:
-                body = json.loads(self.rfile.read(length))
-            except json.JSONDecodeError:
-                self._reply(400, errors.new_bad_request("invalid JSON").status())
+                body = codec.decode(raw) \
+                    if ctype == codec.BINARY_MEDIA_TYPE else json.loads(raw)
+            except (json.JSONDecodeError, ValueError, IndexError):
+                self._reply(400, errors.new_bad_request(
+                    "invalid request body").status())
                 return
         try:
             user = ""
@@ -520,20 +625,33 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(result[0], result[1])
 
     def _reply(self, code: int, obj: Any) -> None:
-        data = json.dumps(obj).encode() if not isinstance(obj, str) \
-            else obj.encode()
+        from kubernetes_tpu.machinery import codec
+
+        if getattr(self, "_binary_reply", False) and not isinstance(obj, str):
+            data = codec.encode(obj)
+            ctype = codec.BINARY_MEDIA_TYPE
+        else:
+            data = json.dumps(obj).encode() if not isinstance(obj, str) \
+                else obj.encode()
+            ctype = "application/json"
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
 
     def _stream_watch(self, w: mwatch.Watch, query: Dict[str, str]) -> None:
-        """Chunked stream of {"type","object"} JSON lines — the watch wire
-        format (apimachinery streaming serializer)."""
+        """Chunked stream of watch events: {"type","object"} JSON lines by
+        default (apimachinery streaming serializer), varint-length-delimited
+        binary frames when the client negotiated the binary codec (the
+        streaming-protobuf seat)."""
+        from kubernetes_tpu.machinery import codec
+
+        binary = getattr(self, "_binary_reply", False)
         timeout = float(query.get("timeoutSeconds", "3600"))
         self.send_response(200)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", codec.BINARY_MEDIA_TYPE if binary
+                         else "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         import time as _time
@@ -545,9 +663,13 @@ class _Handler(BaseHTTPRequestHandler):
                     if w.stopped:
                         break
                     continue
-                line = json.dumps({"type": ev.type, "object": ev.object},
-                                  separators=(",", ":")) + "\n"
-                chunk = line.encode()
+                if binary:
+                    chunk = codec.encode_frame(
+                        {"type": ev.type, "object": ev.object})
+                else:
+                    chunk = (json.dumps(
+                        {"type": ev.type, "object": ev.object},
+                        separators=(",", ":")) + "\n").encode()
                 self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):

@@ -14,7 +14,7 @@ from kubernetes_tpu.apiserver import (
     TokenAuthenticator,
 )
 from kubernetes_tpu.client import Client
-from kubernetes_tpu.machinery import errors
+from kubernetes_tpu.machinery import errors, meta
 
 
 @pytest.fixture
@@ -262,6 +262,140 @@ class TestCRD:
         ev = w.next(timeout=2)
         assert ev is not None and ev.object["metadata"]["name"] == "w1"
         w.stop()
+
+    MULTIVER_CRD = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "widgets.shop.example.com"},
+        "spec": {
+            "group": "shop.example.com",
+            "scope": "Namespaced",
+            "names": {"plural": "widgets", "kind": "Widget"},
+            "conversion": {
+                "strategy": "Webhook",
+                "webhook": {"clientConfig":
+                            {"url": "local://widget-converter"}},
+            },
+            "versions": [
+                {"name": "v1", "served": True, "storage": True},
+                {"name": "v2", "served": True, "storage": False},
+            ],
+        },
+    }
+
+    @staticmethod
+    def _widget_converter(review):
+        """v1.spec.size ↔ v2.spec.replicas (the classic rename migration)."""
+        req = review["request"]
+        want = req["desiredAPIVersion"].rsplit("/", 1)[1]
+        out = []
+        for o in req["objects"]:
+            o = dict(o)
+            spec = dict(o.get("spec", {}))
+            if want == "v2" and "size" in spec:
+                spec["replicas"] = spec.pop("size")
+            elif want == "v1" and "replicas" in spec:
+                spec["size"] = spec.pop("replicas")
+            o["spec"] = spec
+            out.append(o)
+        return {"response": {"uid": req["uid"],
+                             "result": {"status": "Success"},
+                             "convertedObjects": out}}
+
+    def test_multi_version_conversion_webhook(self, api):
+        """apiextensions conversion/converter.go: write v1, read v2, watch
+        sees converted objects; v2 writes persist at the v1 storage
+        version."""
+        from kubernetes_tpu.apiserver.webhooks import (
+            register_local_webhook, unregister_local_webhook,
+        )
+
+        register_local_webhook("local://widget-converter",
+                               self._widget_converter)
+        try:
+            client = Client.local(api)
+            client.customresourcedefinitions.create(self.MULTIVER_CRD)
+            w1 = client.resource("shop.example.com", "v1", "widgets", True)
+            w2 = client.resource("shop.example.com", "v2", "widgets", True)
+
+            # watch at v2 BEFORE writing at v1: events must arrive converted
+            watch2 = w2.watch("default")
+            w1.create({"apiVersion": "shop.example.com/v1", "kind": "Widget",
+                       "metadata": {"name": "a", "namespace": "default"},
+                       "spec": {"size": 3}})
+            ev = watch2.next(timeout=5)
+            assert ev is not None
+            assert ev.object["apiVersion"] == "shop.example.com/v2"
+            assert ev.object["spec"] == {"replicas": 3}
+            watch2.stop()
+
+            # read at both versions
+            assert w1.get("a")["spec"] == {"size": 3}
+            got2 = w2.get("a")
+            assert got2["apiVersion"] == "shop.example.com/v2"
+            assert got2["spec"] == {"replicas": 3}
+            lst = w2.list("default")
+            assert lst["items"][0]["spec"] == {"replicas": 3}
+            # the list ENVELOPE converts too, not just the items
+            assert lst["apiVersion"] == "shop.example.com/v2"
+
+            # write at v2 → persists at storage v1
+            w2.create({"apiVersion": "shop.example.com/v2", "kind": "Widget",
+                       "metadata": {"name": "b", "namespace": "default"},
+                       "spec": {"replicas": 7}})
+            assert w1.get("b")["spec"] == {"size": 7}
+            # round-trip update at v2 keeps the storage form
+            cur = w2.get("b")
+            cur["spec"]["replicas"] = 9
+            w2.update(cur, "default")
+            assert w1.get("b")["spec"] == {"size": 9}
+
+            # both versions are discoverable
+            groups = api.discovery_groups()
+            shop = next(g for g in groups["groups"]
+                        if g["name"] == "shop.example.com")
+            assert {v["version"] for v in shop["versions"]} == {"v1", "v2"}
+            res2 = api.discovery_resources("shop.example.com", "v2")
+            assert any(r["name"] == "widgets" for r in res2["resources"])
+        finally:
+            unregister_local_webhook("local://widget-converter")
+
+    def test_multi_version_strategy_none(self, api):
+        """strategy None: apiVersion rewrite only (converter.go's
+        nopConverter)."""
+        crd = meta.deep_copy(self.MULTIVER_CRD)
+        crd["metadata"]["name"] = "gears.shop.example.com"
+        crd["spec"]["names"] = {"plural": "gears", "kind": "Gear"}
+        crd["spec"]["conversion"] = {"strategy": "None"}
+        client = Client.local(api)
+        client.customresourcedefinitions.create(crd)
+        g1 = client.resource("shop.example.com", "v1", "gears", True)
+        g2 = client.resource("shop.example.com", "v2", "gears", True)
+        g1.create({"apiVersion": "shop.example.com/v1", "kind": "Gear",
+                   "metadata": {"name": "g", "namespace": "default"},
+                   "spec": {"teeth": 12}})
+        got = g2.get("g")
+        assert got["apiVersion"] == "shop.example.com/v2"
+        assert got["spec"] == {"teeth": 12}
+
+    def test_unserved_storage_version_is_not_served(self, api):
+        """A served:false storage version (legal mid-migration shape) must
+        not be the version the resource serves at."""
+        crd = meta.deep_copy(self.MULTIVER_CRD)
+        crd["metadata"]["name"] = "cogs.shop.example.com"
+        crd["spec"]["names"] = {"plural": "cogs", "kind": "Cog"}
+        crd["spec"]["conversion"] = {"strategy": "None"}
+        crd["spec"]["versions"] = [
+            {"name": "v1", "served": False, "storage": True},
+            {"name": "v2", "served": True, "storage": False},
+        ]
+        client = Client.local(api)
+        client.customresourcedefinitions.create(crd)
+        c2 = client.resource("shop.example.com", "v2", "cogs", True)
+        c2.create({"apiVersion": "shop.example.com/v2", "kind": "Cog",
+                   "metadata": {"name": "c", "namespace": "default"},
+                   "spec": {"n": 1}})
+        assert c2.get("c")["spec"] == {"n": 1}
 
     def test_crd_survives_restart(self, api):
         client = Client.local(api)

@@ -1,0 +1,200 @@
+"""API round-trip fuzzing: the apimachinery `roundtrip` analog (SURVEY §4.7).
+
+The reference fuzzes every registered type through encode/decode across
+versions (apimachinery/pkg/api/apitesting/roundtrip). Here the guarded
+boundary is `api/v1.py`'s v1-JSON ↔ framework-object converters — the wire
+the extender server speaks and every watch-fed component parses: a randomized
+Pod/Node goes object → v1 JSON → (real json.dumps/loads) → object and must
+come back identical; the JSON form itself must be stable across a second
+round trip."""
+
+import json
+import random
+import string
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Affinity, HostPort, LabelSelector, Node, NodeSelector, NodeSelectorTerm,
+    Op, Pod, PodAffinityTerm, PreferredSchedulingTerm, Requirement, Resources,
+    Taint, TaintEffect, Toleration, TolerationOp, TopologySpreadConstraint,
+    UnsatisfiableAction, WeightedPodAffinityTerm,
+)
+from kubernetes_tpu.api.v1 import (
+    node_from_v1, node_to_v1, pod_from_v1, pod_to_v1,
+)
+
+
+def rs(rng, n=8):
+    return "".join(rng.choice(string.ascii_lowercase + string.digits)
+                   for _ in range(rng.randint(1, n)))
+
+
+def rand_requirement(rng, label_sel=False):
+    ops = [Op.IN, Op.NOT_IN, Op.EXISTS, Op.DOES_NOT_EXIST]
+    if not label_sel:
+        ops += [Op.GT, Op.LT]
+    op = rng.choice(ops)
+    if op in (Op.EXISTS, Op.DOES_NOT_EXIST):
+        values = ()
+    elif op in (Op.GT, Op.LT):
+        values = (str(rng.randint(0, 999)),)
+    else:
+        values = tuple(rs(rng) for _ in range(rng.randint(1, 3)))
+    return Requirement(rs(rng), op, values)
+
+
+def rand_label_selector(rng):
+    return LabelSelector(tuple(rand_requirement(rng, label_sel=True)
+                               for _ in range(rng.randint(0, 3))))
+
+
+def rand_node_term(rng):
+    return NodeSelectorTerm(
+        requirements=tuple(rand_requirement(rng)
+                           for _ in range(rng.randint(0, 3))),
+        field_name_in=tuple(rs(rng) for _ in range(rng.randint(0, 2))))
+
+
+def rand_pod_term(rng):
+    return PodAffinityTerm(
+        selector=rand_label_selector(rng),
+        topology_key=rng.choice(["topology.kubernetes.io/zone",
+                                 "kubernetes.io/hostname", "rack"]),
+        namespaces=tuple(sorted({rs(rng)
+                                 for _ in range(rng.randint(0, 2))})))
+
+
+def rand_affinity(rng):
+    return Affinity(
+        node_required=NodeSelector(tuple(
+            rand_node_term(rng) for _ in range(rng.randint(1, 2))))
+        if rng.random() < 0.5 else None,
+        node_preferred=tuple(
+            PreferredSchedulingTerm(weight=rng.randint(1, 100),
+                                    term=rand_node_term(rng))
+            for _ in range(rng.randint(0, 2))),
+        pod_required=tuple(rand_pod_term(rng)
+                           for _ in range(rng.randint(0, 2))),
+        pod_preferred=tuple(
+            WeightedPodAffinityTerm(weight=rng.randint(1, 100),
+                                    term=rand_pod_term(rng))
+            for _ in range(rng.randint(0, 2))),
+        anti_required=tuple(rand_pod_term(rng)
+                            for _ in range(rng.randint(0, 2))),
+        anti_preferred=tuple(
+            WeightedPodAffinityTerm(weight=rng.randint(1, 100),
+                                    term=rand_pod_term(rng))
+            for _ in range(rng.randint(0, 2))),
+    )
+
+
+def rand_pod(rng, i):
+    """A random Pod over the round-trippable field set (pod_to_v1's
+    contract: limits/volumes/images/spread_selectors/creation_index are
+    scheduler-internal and not carried on this wire)."""
+    return Pod(
+        name=f"p{i}-{rs(rng)}",
+        namespace=rng.choice(["default", "kube-system", rs(rng)]),
+        labels={rs(rng): rs(rng) for _ in range(rng.randint(0, 4))},
+        requests=Resources(
+            milli_cpu=rng.randint(0, 64000),
+            memory_kib=rng.randint(0, 1 << 30),
+            ephemeral_kib=rng.randint(0, 1 << 20)
+            if rng.random() < 0.5 else 0,
+            pods=1,  # pod_request_from_spec counts the pod itself
+            scalars=tuple(sorted(
+                {f"example.com/{rs(rng)}": rng.randint(1, 8)
+                 for _ in range(rng.randint(0, 2))}.items()))),
+        node_selector={rs(rng): rs(rng)
+                       for _ in range(rng.randint(0, 2))},
+        affinity=rand_affinity(rng),
+        tolerations=tuple(
+            Toleration(key=rs(rng),
+                       op=rng.choice([TolerationOp.EXISTS,
+                                      TolerationOp.EQUAL]),
+                       value=rs(rng) if rng.random() < 0.5 else "",
+                       effect=rng.choice([None, TaintEffect.NO_SCHEDULE,
+                                          TaintEffect.PREFER_NO_SCHEDULE,
+                                          TaintEffect.NO_EXECUTE]))
+            for _ in range(rng.randint(0, 3))),
+        topology_spread=tuple(
+            TopologySpreadConstraint(
+                max_skew=rng.randint(1, 5),
+                topology_key=rng.choice(["zone", "rack"]),
+                when_unsatisfiable=rng.choice(
+                    [UnsatisfiableAction.DO_NOT_SCHEDULE,
+                     UnsatisfiableAction.SCHEDULE_ANYWAY]),
+                selector=rand_label_selector(rng))
+            for _ in range(rng.randint(0, 2))),
+        host_ports=tuple(
+            HostPort(port=rng.randint(1, 65535),
+                     protocol=rng.choice(["TCP", "UDP"]),
+                     host_ip=rng.choice(["", "10.0.0.1"]))
+            for _ in range(rng.randint(0, 2))),
+        priority=rng.randint(-100, 1000000),
+        node_name=rs(rng) if rng.random() < 0.3 else "",
+        # min_member rides the group annotation: without a group it has no
+        # wire representation (and no meaning)
+        **({"pod_group": f"grp-{rs(rng)}",
+            "min_member": rng.randint(1, 8)}
+           if rng.random() < 0.3 else {}),
+    )
+
+
+def rand_node(rng, i):
+    return Node(
+        name=f"n{i}-{rs(rng)}",
+        labels={rs(rng): rs(rng) for _ in range(rng.randint(0, 4))},
+        allocatable=Resources(
+            milli_cpu=rng.randint(1000, 128000),
+            memory_kib=rng.randint(1 << 20, 1 << 30),
+            ephemeral_kib=rng.randint(0, 1 << 25),
+            pods=rng.randint(10, 500),
+            scalars=tuple(sorted(
+                {f"example.com/{rs(rng)}": rng.randint(1, 16)
+                 for _ in range(rng.randint(0, 2))}.items()))),
+        taints=tuple(
+            Taint(key=rs(rng), value=rs(rng) if rng.random() < 0.5 else "",
+                  effect=rng.choice([TaintEffect.NO_SCHEDULE,
+                                     TaintEffect.PREFER_NO_SCHEDULE,
+                                     TaintEffect.NO_EXECUTE]))
+            for _ in range(rng.randint(0, 3))),
+        unschedulable=rng.random() < 0.2,
+        images_kib={f"reg/{rs(rng)}:v{j}": rng.randint(1, 1 << 20)
+                    for j in range(rng.randint(0, 3))},
+    )
+
+
+class TestPodRoundTrip:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_pod_fuzz(self, seed):
+        rng = random.Random(seed)
+        for i in range(50):
+            pod = rand_pod(rng, i)
+            wire = json.loads(json.dumps(pod_to_v1(pod)))
+            back = pod_from_v1(wire)
+            assert back == pod, f"seed={seed} i={i}"
+            # second trip: the JSON form is a fixpoint
+            assert pod_to_v1(back) == pod_to_v1(pod)
+
+    def test_gang_label_wins_over_annotation(self):
+        wire = {"metadata": {
+            "name": "g", "namespace": "default",
+            "labels": {"pod-group.scheduling.sigs.k8s.io/name": "from-label"},
+            "annotations": {
+                "pod-group.scheduling.sigs.k8s.io/name": "from-ann"}},
+            "spec": {"containers": []}}
+        assert pod_from_v1(wire).pod_group == "from-label"
+
+
+class TestNodeRoundTrip:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_node_fuzz(self, seed):
+        rng = random.Random(seed)
+        for i in range(50):
+            node = rand_node(rng, i)
+            wire = json.loads(json.dumps(node_to_v1(node)))
+            back = node_from_v1(wire)
+            assert back == node, f"seed={seed} i={i}"
+            assert node_to_v1(back) == node_to_v1(node)
