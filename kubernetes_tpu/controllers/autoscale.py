@@ -22,6 +22,8 @@
 from __future__ import annotations
 
 import math
+import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from kubernetes_tpu.machinery import errors, meta
@@ -40,12 +42,67 @@ _SCALE_TARGETS = {
 
 
 def annotation_metrics(pod: Dict) -> Optional[float]:
-    """Default per-pod CPU utilization source (percent of request)."""
+    """Annotation-carried per-pod CPU utilization (percent of request) — the
+    test-fixture source, and the fallback when no metrics API is serving."""
     v = meta.annotations_of(pod).get(CPU_ANNOTATION)
     try:
         return float(v) if v is not None else None
     except (TypeError, ValueError):
         return None
+
+
+class ResourceMetricsProvider:
+    """The HPA's metrics-client seat (horizontal.go:96 via
+    pkg/controller/podautoscaler/metrics RESTMetricsClient): per-pod CPU
+    utilization = usage from the resource-metrics API
+    (metrics.k8s.io/v1beta1 PodMetrics, served through the aggregator by
+    component/metrics_server.py) ÷ the pod's CPU request. Falls back to the
+    annotation source when the API is not serving (no metrics-server
+    installed), so fixture-driven tests keep working."""
+
+    def __init__(self, client, cache_ttl: float = 1.0,
+                 clock: Optional[Callable[[], float]] = None):
+        self.client = client
+        self.cache_ttl = cache_ttl
+        self.clock = clock or time.monotonic
+        self._mu = threading.Lock()
+        self._cache: Dict[str, tuple] = {}  # ns → (fetched_at, {pod: milli})
+
+    def _usage_by_pod(self, ns: str) -> Optional[Dict[str, int]]:
+        now = self.clock()
+        with self._mu:
+            hit = self._cache.get(ns)
+            if hit is not None and now - hit[0] < self.cache_ttl:
+                return hit[1]
+        from kubernetes_tpu.api.v1 import parse_cpu_milli
+
+        try:
+            lst = self.client.resource(
+                "metrics.k8s.io", "v1beta1", "pods", True).list(ns)
+        except errors.StatusError:
+            return None  # API not serving → caller falls back
+        usage = {}
+        for m in lst.get("items", []):
+            usage[meta.name(m)] = sum(
+                parse_cpu_milli((c.get("usage") or {}).get("cpu", 0))
+                for c in m.get("containers", []))
+        with self._mu:
+            self._cache[ns] = (now, usage)
+        return usage
+
+    def __call__(self, pod: Dict) -> Optional[float]:
+        usage = self._usage_by_pod(meta.namespace(pod))
+        if usage is None:
+            return annotation_metrics(pod)
+        milli = usage.get(meta.name(pod))
+        if milli is None:
+            return None  # no sample yet (reference: pod skipped this cycle)
+        from kubernetes_tpu.api.v1 import pod_request_from_spec
+
+        req = pod_request_from_spec(pod.get("spec", {}) or {}).milli_cpu
+        if req <= 0:
+            return None  # utilization is undefined without a request
+        return 100.0 * milli / req
 
 
 class HorizontalPodAutoscalerController(Controller):
@@ -55,9 +112,11 @@ class HorizontalPodAutoscalerController(Controller):
     name = "horizontalpodautoscaler"
 
     def __init__(self, client, factory: InformerFactory,
-                 metrics: Callable[[Dict], Optional[float]] = annotation_metrics):
+                 metrics: Optional[Callable[[Dict], Optional[float]]] = None):
         super().__init__(client, factory)
-        self.metrics = metrics
+        # default: the resource-metrics API client (with annotation
+        # fallback) — the reference's RESTMetricsClient wiring
+        self.metrics = metrics or ResourceMetricsProvider(client)
         self.hpa_informer = self.watch_resource("horizontalpodautoscalers")
         self.pod_informer = self.factory.informer("pods")
         # metric changes arrive as pod updates → resync the owning HPAs

@@ -287,6 +287,33 @@ class Kubelet:
                 pass
 
     # ------------------------------------------------------------------ #
+    # stats (pkg/kubelet/server/stats /stats/summary): the scrape surface
+    # the resource-metrics pipeline aggregates from
+    # ------------------------------------------------------------------ #
+
+    def stats_summary(self) -> Obj:
+        """Per-pod cpu/memory usage from the CRI (ListContainerStats),
+        summed across containers and tagged with this node — the
+        /stats/summary payload metrics-server scrapes."""
+        try:
+            stats = self.cri.list_stats()
+        except CRIError:
+            return {"node": self.node_name, "pods": []}
+        by_pod: Dict[tuple, Obj] = {}
+        for s in stats:
+            key = (s["podNamespace"], s["podName"])
+            agg = by_pod.setdefault(key, {
+                "namespace": s["podNamespace"], "name": s["podName"],
+                "uid": s.get("podUid", ""), "cpuMilli": 0, "memoryBytes": 0,
+                "containers": []})
+            agg["cpuMilli"] += s["cpuMilli"]
+            agg["memoryBytes"] += s["memoryBytes"]
+            agg["containers"].append({"name": s["name"],
+                                      "cpuMilli": s["cpuMilli"],
+                                      "memoryBytes": s["memoryBytes"]})
+        return {"node": self.node_name, "pods": list(by_pod.values())}
+
+    # ------------------------------------------------------------------ #
     # status manager (pkg/kubelet/status): compute + dedupe + write
     # ------------------------------------------------------------------ #
 
