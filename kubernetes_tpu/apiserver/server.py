@@ -299,6 +299,11 @@ class APIServer:
                             "verbs": ["create", "delete", "deletecollection",
                                       "get", "list", "patch", "update",
                                       "watch"]})
+                for sub in info.subresources:
+                    out.append({"name": f"{info.resource}/{sub}",
+                                "kind": info.kind,
+                                "namespaced": info.namespaced,
+                                "verbs": ["get", "update", "patch"]})
                 continue
             if info.group == group and info.version == version:
                 out.append({"name": info.resource, "kind": info.kind,
@@ -344,10 +349,13 @@ class _ConvertingWatch:
             return ev
         try:
             return mwatch.Event(ev.type, self._fn(ev.object))
-        except errors.StatusError:
-            # converter failure mid-stream: terminate like a slow watcher
+        except errors.StatusError as e:
+            # converter failure mid-stream: surface it as a watch ERROR
+            # (the reference's watch stream carries a Status event), then
+            # end the stream — a silent clean EOF would hide the fault in
+            # an indefinite relist loop
             self._w.stop()
-            return None
+            return mwatch.Event(mwatch.ERROR, e.status())
 
     def stop(self) -> None:
         self._w.stop()
@@ -386,7 +394,14 @@ def handle_rest(api: APIServer, method: str, path: str,
         entry, want = _conversion_for(api, path)
     if entry is not None and isinstance(body, dict) and \
             method in ("POST", "PUT"):
-        body = entry.convert([body], entry.storage)[0]
+        try:
+            body = entry.convert([body], entry.storage)[0]
+        except errors.StatusError as e:
+            # a converter-down failure is still an audited outcome of the
+            # attempted mutation ("both outcomes" holds for conversion too)
+            if method in _AUDIT_VERBS:
+                _audit(api, method, path, e.code, user, meta.name(body))
+            raise
     out = _handle_rest_audited(api, method, path, query, body, user)
     if entry is None:
         return out

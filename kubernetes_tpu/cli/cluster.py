@@ -46,6 +46,34 @@ class ClusterConfig:
     scheduler_config: Optional[object] = None
 
 
+def _parse_version(v: str):
+    """'v1.17.0-tpu.1' → (1, 17, 0); None if unparseable."""
+    core = v.lstrip("v").split("-")[0]
+    try:
+        parts = [int(x) for x in core.split(".")[:3]]
+        while len(parts) < 3:
+            parts.append(0)
+        return tuple(parts)
+    except ValueError:
+        return None
+
+
+def _skew_allows(cur: str, target: str):
+    """kubeadm's version-skew policy (phases/upgrade/policy.go): no
+    downgrades, at most one minor-version jump."""
+    c, t = _parse_version(cur), _parse_version(target)
+    if c is None or t is None:
+        return False, f"unparseable version: {cur!r} -> {target!r}"
+    if t < c:
+        return False, f"downgrade {cur} -> {target} is not supported"
+    if t[0] != c[0]:
+        return False, f"major version change {cur} -> {target} not supported"
+    if t[1] > c[1] + 1:
+        return False, (f"cannot skip minor versions: {cur} -> {target} "
+                       "(one minor at a time)")
+    return True, ""
+
+
 class Cluster:
     """All control-plane components in one process (the integration-test /
     local-dev topology; each component still talks REST through the gateway
@@ -100,6 +128,108 @@ class Cluster:
             capacity=capacity or self.config.hollow_capacity).start()
         self._joined.append(extra)
         return extra
+
+    # -- upgrade (cmd/kubeadm/app/phases/upgrade) --------------------------- #
+
+    def _stored_cluster_config(self) -> Dict:
+        """The kubeadm-config ConfigMap in kube-system — where kubeadm
+        persists ClusterConfiguration (incl. kubernetesVersion)."""
+        try:
+            return self.client.configmaps.get("kubeadm-config", "kube-system")
+        except Exception:  # noqa: BLE001 — absent on pre-upgrade clusters
+            return {}
+
+    def current_version(self) -> str:
+        cm = self._stored_cluster_config()
+        stored = (cm.get("data") or {}).get("kubernetesVersion", "")
+        if stored:
+            return stored
+        return self.client.version().get("gitVersion", "")
+
+    def upgrade_plan(self, target: str) -> Dict:
+        """`kubeadm upgrade plan`: health + skew preflight, no mutation
+        (phases/upgrade/plan.go: current/target versions, component health,
+        per-node kubelet versions)."""
+        cur = self.current_version()
+        components = {
+            "apiserver": self._healthz(),
+            "scheduler": self.scheduler is not None,
+            "controller-manager": self.manager is not None,
+        }
+        nodes = []
+        for n in self.client.nodes.list("").get("items", []):
+            ready = any(c.get("type") == "Ready" and c.get("status") == "True"
+                        for c in n.get("status", {}).get("conditions", []))
+            nodes.append({
+                "name": n["metadata"]["name"], "ready": ready,
+                "kubeletVersion": n.get("status", {})
+                .get("nodeInfo", {}).get("kubeletVersion", "")})
+        ok, reason = _skew_allows(cur, target)
+        return {"currentVersion": cur, "targetVersion": target,
+                "components": components, "nodes": nodes,
+                "canUpgrade": ok and all(components.values()),
+                "reason": reason if not ok else "", }
+
+    def _healthz(self) -> bool:
+        try:
+            return self.client.transport.request(
+                "GET", "/healthz", {}, None) is not None
+        except Exception:  # noqa: BLE001
+            return False
+
+    def upgrade_apply(self, target: str) -> Dict:
+        """`kubeadm upgrade apply <target>`: preflight → ComponentConfig
+        migration → control-plane restart (scheduler, then controller
+        manager, against the same durable storage — no placement loss) →
+        record the new version in kubeadm-config. Each phase is recorded
+        the way kubeadm's phase runner reports them."""
+        phases: List[str] = []
+        plan = self.upgrade_plan(target)
+        if not plan["canUpgrade"]:
+            raise RuntimeError(
+                f"preflight failed: {plan.get('reason') or plan['components']}")
+        phases.append("preflight")
+
+        # config migration: the scheduler config must still load under the
+        # new version (phases/upgrade/postupgrade.go ComponentConfig check)
+        if self.config.scheduler_config is not None:
+            from kubernetes_tpu.sched.config import load_config
+
+            load_config(self.config.scheduler_config)
+        phases.append("config")
+
+        # control plane, one component at a time; the apiserver (storage)
+        # stays up throughout, as in a real rolling control-plane upgrade
+        self.scheduler.stop()
+        self.scheduler = SchedulerServer(
+            self.client, scheduler_name=self.config.scheduler_name,
+            leader_elect=self.config.leader_elect,
+            config=self.config.scheduler_config).start()
+        phases.append("control-plane/scheduler")
+        self.manager.stop()
+        self.manager = ControllerManager(
+            self.client, controllers=self.config.controllers,
+            leader_elect=self.config.leader_elect).start()
+        phases.append("control-plane/controller-manager")
+
+        # persist the new ClusterConfiguration version (uploadconfig phase)
+        cm = self._stored_cluster_config()
+        if cm:
+            cm.setdefault("data", {})["kubernetesVersion"] = target
+            self.client.configmaps.update(cm, "kube-system")
+        else:
+            self.client.configmaps.create(
+                {"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "kubeadm-config",
+                              "namespace": "kube-system"},
+                 "data": {"kubernetesVersion": target}}, "kube-system")
+        phases.append("upload-config")
+
+        if not self._healthz():
+            raise RuntimeError("post-upgrade health check failed")
+        phases.append("health")
+        return {"from": plan["currentVersion"], "to": target,
+                "phases": phases}
 
     def down(self) -> None:
         for extra in reversed(self._joined):

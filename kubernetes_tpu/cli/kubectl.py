@@ -321,6 +321,84 @@ class Kubectl:
         self.out.write(f"node/{node} {action}\n")
         return 0
 
+    def diff(self, filename: str, namespace: str = "default") -> int:
+        """kubectl diff (staging/src/k8s.io/kubectl/pkg/cmd/diff): show what
+        apply WOULD change, without changing it. The merged result is
+        computed with the server's own JSON-merge semantics
+        (apiserver/registry.py `_merge_patch` — the reference does this as
+        a server-side dry-run apply) and printed as a unified diff of live
+        vs merged. Exit code 1 when differences exist, 0 when none — the
+        reference's contract."""
+        import difflib
+
+        from kubernetes_tpu.apiserver.registry import _merge_patch
+
+        changed = False
+        for obj in self._load_manifests(filename):
+            rc = self._rc_for_obj(obj)
+            ns = (meta.namespace(obj) or namespace) if rc.namespaced else ""
+            name = meta.name(obj)
+            desired = {k: v for k, v in obj.items() if k != "status"}
+            try:
+                live = rc.get(name, ns)
+                merged = _merge_patch(meta.deep_copy(live), desired)
+            except errors.StatusError as e:
+                if not errors.is_not_found(e):
+                    raise
+                live, merged = {}, desired  # would be created
+            def strip(o: Obj) -> Obj:
+                o = meta.deep_copy(o)
+                md = o.get("metadata", {})
+                for k in ("resourceVersion", "uid", "creationTimestamp",
+                          "generation"):
+                    md.pop(k, None)
+                return o
+            a = json.dumps(strip(live), indent=2, sort_keys=True)
+            b = json.dumps(strip(merged), indent=2, sort_keys=True)
+            if a == b:
+                continue
+            changed = True
+            tag = f"{obj.get('kind', '').lower()}/{name}"
+            self.out.write("".join(difflib.unified_diff(
+                a.splitlines(keepends=True), b.splitlines(keepends=True),
+                fromfile=f"live/{tag}", tofile=f"merged/{tag}")))
+            if not a.endswith("\n"):
+                self.out.write("\n")
+        return 1 if changed else 0
+
+    def explain(self, path: str) -> int:
+        """kubectl explain (staging/src/k8s.io/kubectl/pkg/cmd/explain):
+        walk a dotted field path through the resource's schema docs —
+        built-in docs for core kinds, the CRD's openAPIV3Schema for custom
+        resources (the reference walks the server's OpenAPI document)."""
+        from kubernetes_tpu.cli.explain import explain_text
+
+        segs = path.split(".")
+        rc = self._rc(segs[0])
+        crd_schema = None
+        if rc.group not in ("", "apps", "batch", "policy"):
+            try:
+                crd = self.client.customresourcedefinitions.get(
+                    f"{rc.resource}.{rc.group}", "")
+                versions = crd.get("spec", {}).get("versions") or []
+                v = next((x for x in versions
+                          if x.get("name") == rc.version), None) \
+                    or (versions[0] if versions else None)
+                crd_schema = ((v or {}).get("schema") or {}).get(
+                    "openAPIV3Schema") or (crd.get("spec", {})
+                                           .get("validation") or {}).get(
+                                               "openAPIV3Schema")
+            except errors.StatusError:
+                pass
+        text = explain_text(rc.resource, rc.group, rc.version, segs[1:],
+                            crd_schema)
+        if text is None:
+            self.err.write(f"error: field {'.'.join(segs)!r} does not "
+                           "exist\n")
+            return 1
+        self.out.write(text)
+        return 0
+
     def api_resources(self) -> int:
         self.out.write("NAME  SHORTNAMES  APIGROUP  NAMESPACED  KIND\n")
         for group, _, r in self._discovered_resources():
@@ -356,9 +434,12 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("resource")
     d.add_argument("name")
 
-    for verb in ("create", "apply"):
+    for verb in ("create", "apply", "diff"):
         c = sub.add_parser(verb)
         c.add_argument("-f", "--filename", required=True)
+
+    ex = sub.add_parser("explain")
+    ex.add_argument("path", help="resource[.field.field...]")
 
     de = sub.add_parser("delete")
     de.add_argument("resource")
@@ -402,6 +483,10 @@ def main(argv: Optional[List[str]] = None, client: Optional[Client] = None,
             return k.create(args.filename, args.namespace)
         if args.verb == "apply":
             return k.apply(args.filename, args.namespace)
+        if args.verb == "diff":
+            return k.diff(args.filename, args.namespace)
+        if args.verb == "explain":
+            return k.explain(args.path)
         if args.verb == "delete":
             return k.delete(args.resource, args.name, args.namespace)
         if args.verb == "scale":
@@ -423,5 +508,6 @@ def main(argv: Optional[List[str]] = None, client: Optional[Client] = None,
             return k.version()
     except errors.StatusError as e:
         err.write(f"Error from server ({e.reason}): {e.message}\n")
-        return 1
+        # kubectl diff reserves rc 1 for "differences found"; errors are >1
+        return 2 if args.verb == "diff" else 1
     return 0

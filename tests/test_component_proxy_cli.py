@@ -271,6 +271,80 @@ spec:
             assert kubectl_main(argv_base + ["version"], out=out) == 0
             assert "tpu" in out.getvalue()
 
+    def test_kubectl_explain_and_diff(self, api, tmp_path):
+        gw = HTTPGateway(api).start()
+        try:
+            argv = ["-s", gw.url]
+            # explain: resource root + nested field walk
+            out = io.StringIO()
+            assert kubectl_main(argv + ["explain", "pods"], out=out) == 0
+            assert "group of containers" in out.getvalue()
+            out = io.StringIO()
+            assert kubectl_main(
+                argv + ["explain", "pods.spec.containers.resources.requests"],
+                out=out) == 0
+            assert "scheduler reserves" in out.getvalue()
+            # bad path → error exit
+            err = io.StringIO()
+            assert kubectl_main(argv + ["explain", "pods.spec.nope"],
+                                out=io.StringIO(), err=err) == 1
+            assert "does not exist" in err.getvalue()
+            # explain a CRD field from its openAPIV3Schema
+            client = Client.http(gw.url)
+            client.customresourcedefinitions.create({
+                "apiVersion": "apiextensions.k8s.io/v1",
+                "kind": "CustomResourceDefinition",
+                "metadata": {"name": "tpujobs.ml.example.com"},
+                "spec": {"group": "ml.example.com", "scope": "Namespaced",
+                         "names": {"plural": "tpujobs", "kind": "TPUJob"},
+                         "versions": [{
+                             "name": "v1", "served": True, "storage": True,
+                             "schema": {"openAPIV3Schema": {
+                                 "type": "object",
+                                 "properties": {"spec": {
+                                     "type": "object",
+                                     "properties": {"replicas": {
+                                         "type": "integer",
+                                         "description":
+                                         "Desired TPU workers."}}}}}}}]}})
+            out = io.StringIO()
+            assert kubectl_main(argv + ["explain", "tpujobs.spec.replicas"],
+                                out=out) == 0
+            assert "Desired TPU workers." in out.getvalue()
+
+            # diff: no live object → whole doc is the diff, rc=1
+            manifest = tmp_path / "cm.yaml"
+            manifest.write_text(
+                "apiVersion: v1\nkind: ConfigMap\n"
+                "metadata: {name: app, namespace: default}\n"
+                "data: {k: v1}\n")
+            out = io.StringIO()
+            assert kubectl_main(argv + ["diff", "-f", str(manifest)],
+                                out=out) == 1
+            assert '"k": "v1"' in out.getvalue()
+            # apply, then diff an unchanged manifest → rc=0, empty
+            assert kubectl_main(argv + ["apply", "-f", str(manifest)],
+                                out=io.StringIO()) == 0
+            out = io.StringIO()
+            assert kubectl_main(argv + ["diff", "-f", str(manifest)],
+                                out=out) == 0
+            assert out.getvalue() == ""
+            # change a value → unified diff with both sides, rc=1
+            manifest.write_text(
+                "apiVersion: v1\nkind: ConfigMap\n"
+                "metadata: {name: app, namespace: default}\n"
+                "data: {k: v2}\n")
+            out = io.StringIO()
+            assert kubectl_main(argv + ["diff", "-f", str(manifest)],
+                                out=out) == 1
+            text = out.getvalue()
+            assert '-    "k": "v1"' in text and '+    "k": "v2"' in text
+            # the live object was NOT modified by diff
+            assert Client.http(gw.url).configmaps.get("app")["data"] == \
+                {"k": "v1"}
+        finally:
+            gw.stop()
+
     def test_kubectl_taint_and_error_paths(self, api):
         gw = HTTPGateway(api).start()
         try:
@@ -425,3 +499,60 @@ class TestClusterLifecycle:
                     "joined-pod").get("spec", {}).get("nodeName"):
                 _t.sleep(0.1)
             assert client.pods.get("joined-pod")["spec"].get("nodeName")
+
+    def test_upgrade_plan_and_apply(self):
+        """kubeadm upgrade (cmd/kubeadm/app/phases/upgrade): plan preflight,
+        skew policy, phased apply with the control plane surviving and the
+        new version recorded in kubeadm-config."""
+        import time as _t
+
+        from kubernetes_tpu.cli.cluster import Cluster, ClusterConfig
+
+        with Cluster(ClusterConfig(hollow_nodes=1)) as cluster:
+            client = cluster.client
+            deadline = _t.time() + 10
+            while _t.time() < deadline and \
+                    len(client.nodes.list()["items"]) < 1:
+                _t.sleep(0.1)
+            cur = cluster.current_version()  # v1.17.x-tpu.*
+            plan = cluster.upgrade_plan("v1.18.0-tpu.1")
+            assert plan["canUpgrade"] and plan["currentVersion"] == cur
+            assert plan["nodes"] and plan["nodes"][0]["ready"]
+            # skew policy: no downgrade, no minor skips
+            assert not cluster.upgrade_plan("v1.16.0")["canUpgrade"]
+            assert not cluster.upgrade_plan("v1.19.0")["canUpgrade"]
+            with pytest.raises(RuntimeError):
+                cluster.upgrade_apply("v1.19.0")
+
+            # a pod placed before the upgrade…
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "pre", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "i"}]}})
+            deadline = _t.time() + 15
+            while _t.time() < deadline and not client.pods.get(
+                    "pre")["spec"].get("nodeName"):
+                _t.sleep(0.1)
+            node_before = client.pods.get("pre")["spec"]["nodeName"]
+            assert node_before
+
+            out = cluster.upgrade_apply("v1.18.0-tpu.1")
+            assert out["phases"] == ["preflight", "config",
+                                     "control-plane/scheduler",
+                                     "control-plane/controller-manager",
+                                     "upload-config", "health"]
+            # version persisted; placements survived; new pods schedule
+            assert cluster.current_version() == "v1.18.0-tpu.1"
+            assert client.pods.get("pre")["spec"]["nodeName"] == node_before
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "post", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "i"}]}})
+            deadline = _t.time() + 20
+            while _t.time() < deadline and not client.pods.get(
+                    "post")["spec"].get("nodeName"):
+                _t.sleep(0.1)
+            assert client.pods.get("post")["spec"].get("nodeName")
+            # second upgrade from the stored version obeys skew from there
+            assert not cluster.upgrade_plan("v1.20.0")["canUpgrade"]
+            assert cluster.upgrade_plan("v1.19.0-tpu.1")["canUpgrade"]
