@@ -362,8 +362,9 @@ class Kubectl:
             self.out.write("".join(difflib.unified_diff(
                 a.splitlines(keepends=True), b.splitlines(keepends=True),
                 fromfile=f"live/{tag}", tofile=f"merged/{tag}")))
-            if not a.endswith("\n"):
-                self.out.write("\n")
+            # json.dumps never ends in a newline, so the diff's final line
+            # is always unterminated
+            self.out.write("\n")
         return 1 if changed else 0
 
     def explain(self, path: str) -> int:

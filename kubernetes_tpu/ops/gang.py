@@ -116,10 +116,10 @@ def assign_gang(
         under = gang.valid & ~rejected & (placed < gang.needed)
         return res, waves, under, placed
 
-    res0, waves0, under0, placed0 = run(jnp.zeros((GR,), bool))
-
     def cond(c: _GangCarry) -> Array:
-        return c.under.any() & (c.rounds < GR + 1)
+        # rounds==0 is the unconditional first run; afterwards loop while
+        # any group is underfilled (each round rejects ≥1, cap GR+2)
+        return (c.rounds == 0) | (c.under.any() & (c.rounds < GR + 2))
 
     def body(c: _GangCarry) -> _GangCarry:
         # zero-placed underfilled groups hold NOTHING: excluding them frees
@@ -130,22 +130,34 @@ def assign_gang(
         # same deferral the Permit-timeout path gives it). PARTIALLY-filled
         # groups do hold capacity; release them one per round (lowest rank
         # first) so survivors absorb the freed space — until soft_rounds,
-        # after which the remaining tail rejects in bulk.
+        # after which the remaining tail rejects in bulk. The first round
+        # (rounds==0, dummy carry) rejects nothing.
         zero = c.under & (c.placed == 0)
         partial = c.under & (c.placed > 0)
         worst = jnp.argmax(jnp.where(partial, gang.rank, -1))
         one = jnp.zeros((GR,), bool).at[worst].set(True) & partial
-        newly = zero | jnp.where(c.rounds >= soft_rounds, partial, one)
+        newly = zero | jnp.where(c.rounds > soft_rounds, partial, one)
+        newly = newly & (c.rounds > 0)
         rejected = c.rejected | newly
         res, waves, under, placed = run(rejected)
         return _GangCarry(rejected=rejected, under=under, placed=placed,
                           rounds=c.rounds + 1, node=res.node,
                           feasible=res.feasible, waves=waves, state=res.state)
 
+    # ONE instance of the wave fixpoint in the program: an unrolled initial
+    # run plus the loop body doubled the compiled graph, which at
+    # 5k nodes × 100k pods × 3.5k classes was enough to take the TPU
+    # worker down; the dummy init carry (under=True, rounds=0) makes the
+    # first loop iteration BE the initial run instead.
     final = lax.while_loop(cond, body, _GangCarry(
-        rejected=jnp.zeros((GR,), bool), under=under0, placed=placed0,
-        rounds=jnp.int32(0), node=res0.node, feasible=res0.feasible,
-        waves=waves0, state=res0.state))
+        rejected=jnp.zeros((GR,), bool),
+        under=jnp.ones((GR,), bool),
+        placed=jnp.zeros((GR,), jnp.int32),
+        rounds=jnp.int32(0),
+        node=jnp.full((P,), -1, jnp.int32),
+        feasible=jnp.zeros((P,), bool),
+        waves=jnp.full((P,), -1, jnp.int32),
+        state=init))
 
     # the loop always exits with `under` empty (each round rejects ≥1 group,
     # capped at GR+1); the strip below also covers the unreachable cap exit

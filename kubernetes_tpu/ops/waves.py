@@ -9,9 +9,12 @@ TPU idle. This module replaces it as the default path. Per wave:
      and Score row against the committed state — one vmapped dense pass over
      [SC, N], the shape the MXU/VPU wants (pods of a class are spec-identical,
      so per-pod rows would be redundant);
-  2. only classes whose next queued pod sits in the current top priority tier
-     admit this wave (activeQ order: priority desc, creation asc —
-     internal/queue/scheduling_queue.go:119-138);
+  2. admission is cross-tier: queue order (activeQ: priority desc, creation
+     asc — internal/queue/scheduling_queue.go:119-138) is enforced where it
+     is OBSERVABLE — through the interaction graph (step 4) and the
+     rank-ordered contention passes (step 5) — instead of a global
+     priority-tier gate, so independent lower-priority classes need not
+     wait out higher tiers wave-by-wave;
   3. each admitting class claims up to one pod per node on its top-scored
      feasible nodes, subject to per-domain quotas that make every same-wave
      admission pair NON-INTERFERING:
@@ -30,9 +33,13 @@ TPU idle. This module replaces it as the default path. Per wave:
      same wave (vectorized independent set — no scan);
   5. same-node contention between classes is resolved in queue order by a
      cumulative resource-sum / port-OR pass; losers retry next wave;
-  6. zero-progress waves mark the entire frozen priority-tier run of each
-     attempting class unschedulable — exactly the outcome of the sequential
-     scan replayed with unchanging state — so the loop always terminates.
+  6. failed runs consume eagerly: a zero-progress wave marks the frozen
+     priority run of every attempting class unschedulable (the sequential
+     scan's outcome on unchanging state), and a class that is
+     Filter-infeasible on every node while ranked ahead of all same-wave
+     admitters consumes its run in that same wave (its pods replay first,
+     against exactly the state that rejected them) — so the loop always
+     terminates and an infeasible head class never costs a dedicated wave.
 
 Soundness invariant (tested in tests/test_waves.py): replaying the final
 assignment wave-by-wave, each pod in queue order, every placement passes the
@@ -66,6 +73,9 @@ _I32_MIN = int(jnp.iinfo(jnp.int32).min)
 import os as _os
 
 _CLASS_BLOCK = int(_os.environ.get("KTPU_CLASS_BLOCK", "1024"))
+# block size for the per-node contention scan (bounds the [block, N, R]
+# temporaries — see the block comment at the scan)
+_CONTENTION_BLOCK = int(_os.environ.get("KTPU_CONTENTION_BLOCK", "256"))
 
 
 class _WaveCarry(NamedTuple):
@@ -167,7 +177,10 @@ def _domain_quota_pass(tables, cyc, state, mask, order_n, allowed_sorted):
     # --- hard topology-spread slots (only self-matching classes move their
     # own counts; others are quota-free here and guarded by the graph).
     # Slots are a vmapped axis, not a Python loop: the traced graph stays the
-    # same size no matter how many TS/AN slots the constraint schema needs. ---
+    # same size no matter how many TS/AN slots the constraint schema needs.
+    # Each family is under lax.cond: a batch with no active slots anywhere
+    # (e.g. gang jobs with plain resource requests) skips the [SC·slots]
+    # sorts entirely at runtime. ---
     def spread_slot(c, t):
         s_id = classes.tsc_term[c, t]
         s = jnp.maximum(s_id, 0)
@@ -188,11 +201,17 @@ def _domain_quota_pass(tables, cyc, state, mask, order_n, allowed_sorted):
         quota = jnp.where(active, quota, _I32_MAX)
         return slot_quota(c, s_id, k, active, quota)
 
-    rows = jax.vmap(
-        lambda c: jax.vmap(lambda t: spread_slot(c, t))(
-            jnp.arange(TS, dtype=jnp.int32))
-    )(jnp.arange(SC, dtype=jnp.int32))            # [SC, TS, N]
-    allowed_sorted = allowed_sorted & rows.all(axis=1)
+    def apply_spread(allowed):
+        rows = jax.vmap(
+            lambda c: jax.vmap(lambda t: spread_slot(c, t))(
+                jnp.arange(TS, dtype=jnp.int32))
+        )(jnp.arange(SC, dtype=jnp.int32))        # [SC, TS, N]
+        return allowed & rows.all(axis=1)
+
+    any_spread = ((classes.tsc_term >= 0) & classes.tsc_hard
+                  & classes.valid[:, None]).any()
+    allowed_sorted = lax.cond(any_spread, apply_spread,
+                              lambda a: a, allowed_sorted)
 
     # --- self-matching anti-affinity slots: one per domain per wave ---
     def anti_slot(c, t):
@@ -204,11 +223,17 @@ def _domain_quota_pass(tables, cyc, state, mask, order_n, allowed_sorted):
                           _I32_MAX)
         return slot_quota(c, s_id, k, active, quota)
 
-    rows = jax.vmap(
-        lambda c: jax.vmap(lambda t: anti_slot(c, t))(
-            jnp.arange(AN, dtype=jnp.int32))
-    )(jnp.arange(SC, dtype=jnp.int32))            # [SC, AN, N]
-    allowed_sorted = allowed_sorted & rows.all(axis=1)
+    def apply_anti(allowed):
+        rows = jax.vmap(
+            lambda c: jax.vmap(lambda t: anti_slot(c, t))(
+                jnp.arange(AN, dtype=jnp.int32))
+        )(jnp.arange(SC, dtype=jnp.int32))        # [SC, AN, N]
+        return allowed & rows.all(axis=1)
+
+    any_anti = ((classes.anti_terms >= 0)
+                & classes.valid[:, None]).any()
+    allowed_sorted = lax.cond(any_anti, apply_anti,
+                              lambda a: a, allowed_sorted)
 
     return allowed_sorted
 
@@ -255,6 +280,17 @@ def assign_waves(
     G = interaction_graph(tables, cyc)
     req_by_class = tables.reqs.vec[jnp.maximum(classes.rid, 0)]  # [SC, R]
 
+    # classes whose Filter feasibility is MONOTONE within a dispatch: state
+    # only tightens for them (used/CNT/ports/volumes grow; anti-affinity
+    # only blocks more). Required pod-affinity (new matches open nodes) and
+    # hard spread (a rising domain-min lifts other domains' quotas) are the
+    # only relaxing predicates; classes without either, once infeasible on
+    # every node, stay infeasible for the rest of the dispatch.
+    mono = (
+        ~(classes.aff_terms >= 0).any(axis=1)
+        & ~((classes.tsc_term >= 0) & classes.tsc_hard).any(axis=1)
+    )
+
     # --- queue order, grouped by class (activeQ comparator within class) ---
     cls_safe = jnp.where(pods.valid, pods.cls, SC)
     sorted_pods = jnp.lexsort((pods.creation, -pods.priority, cls_safe))  # [P]
@@ -276,45 +312,73 @@ def assign_waves(
         remaining = class_total - cursor
         active = classes.valid & (remaining > 0)
 
-        # next pending pod per class → tier selection
+        # next pending pod per class. Admission is CROSS-TIER: a class needs
+        # no global priority-tier gate because everything priority order can
+        # observe is already serialized in rank order — interacting classes
+        # through the graph block below, same-node resources/ports/volumes
+        # through the rank-ordered cumulative passes. A lower-priority pod
+        # admitted alongside a higher-priority one replays after it
+        # (wave, priority, creation) and sees identical committed state.
         nxt = sorted_pods_pad[jnp.minimum(class_offset + cursor, P)]
         nxt_ok = active & (nxt < P)
         nxt_safe = jnp.minimum(nxt, P - 1)
-        # i32 min is the neutral element, not a magic sentinel: in_tier also
-        # requires nxt_ok, so even real INT32_MIN priorities tier correctly
+        # i32 min is the neutral element, not a magic sentinel: run counts
+        # also require nxt_ok, so real INT32_MIN priorities still work
         nxt_pri = jnp.where(nxt_ok, pods.priority[nxt_safe], _I32_MIN)
         nxt_cre = jnp.where(nxt_ok, pods.creation[nxt_safe], _I32_MAX)
-        tier = nxt_pri.max()
-        in_tier = nxt_ok & (nxt_pri == tier)
 
-        # length of the tier run per class (pods at exactly this priority
-        # remaining at/after the cursor)
-        tier_pod = (
-            sorted_valid & (pri_sorted == tier)
+        # length of each class's CURRENT priority run (pods at the class's
+        # own head priority, at/after the cursor) — the unit that fails
+        # together when the head pod is infeasible against frozen state
+        run_pod = (
+            sorted_valid & (pri_sorted == nxt_pri[cls_sorted])
             & (pos_in_class >= cursor[cls_sorted])
         )
-        tier_cnt = (
+        run_cnt = (
             jnp.zeros((SC,), jnp.int32).at[cls_sorted].add(
-                tier_pod.astype(jnp.int32))
+                run_pod.astype(jnp.int32))
         )
-        r = jnp.where(in_tier, jnp.minimum(remaining, tier_cnt), 0)
+        r = jnp.where(nxt_ok, jnp.minimum(remaining, run_cnt), 0)
 
         mask, score = _class_mask_score(tables, cyc, state)
-        mask = mask & in_tier[:, None]
+        mask = mask & nxt_ok[:, None]
         r = _escape_cap(tables, cyc, state, r)
 
         # independent set over the interaction graph, queue-rank order:
-        # a class yields to any earlier-ranked in-tier class it interacts with
-        rank_key = jnp.lexsort((nxt_cre, -nxt_pri))          # [SC] perm
+        # a class yields to any earlier-ranked ACTIVE class it interacts
+        # with (in-tier or not — the earlier class admits first, this wave
+        # or a later one). Inactive classes rank LAST via the explicit
+        # primary key (negating their _I32_MIN sentinel priority overflows
+        # i32 and would rank them first, handing active classes nonzero
+        # ranks — and nonzero tie-rotation offsets — they must not have);
+        # priority-descending uses the order-preserving unsigned bias, so
+        # real INT32_MIN priorities sort correctly without x64.
+        pri_desc = ~(nxt_pri.astype(jnp.uint32) ^ jnp.uint32(0x80000000))
+        rank_key = jnp.lexsort((nxt_cre, pri_desc, ~nxt_ok))  # [SC] perm
         crank = jnp.zeros((SC,), jnp.int32).at[rank_key].set(
             jnp.arange(SC, dtype=jnp.int32))
         earlier = crank[None, :] < crank[:, None]            # [SC, SC]
-        blocked = (G & earlier & in_tier[None, :]).any(axis=1)
-        attempted = in_tier & ~blocked & (r > 0)
+        blocked = (G & earlier & nxt_ok[None, :]).any(axis=1)
+        attempted = nxt_ok & ~blocked & (r > 0)
         r = jnp.where(attempted, r, 0)
 
-        # per-class admission: top-r feasible nodes by score, domain quotas
-        order_n = jnp.argsort(-score, axis=1)                # [SC, N]
+        # per-class admission: top-r feasible nodes by score, domain quotas.
+        # Equal-score ties resolve from a rotated start index keyed to the
+        # class's QUEUE RANK within this batch — the reference's round-robin
+        # node offset (generic_scheduler.go:502 nextStartNodeIndex): on a
+        # uniform cluster every class's score row is CONSTANT, and without
+        # rotation all classes pile onto the same lowest-index nodes, so
+        # rank-ordered contention admits a trickle per wave (observed: 69
+        # waves at 2k nodes × 1.4k classes; ~7 with rotation). The rank (not
+        # the global interned class index) keeps any single-pending-class
+        # batch at offset 0 → identical to the sequential scan's
+        # argmax-lowest-index (PARITY #1, tests' singleton agreement).
+        offs = (crank * 97) % N
+        rot = (jnp.arange(N, dtype=jnp.int32)[None, :]
+               + offs[:, None]) % N                          # [SC, N]
+        score_rot = jnp.take_along_axis(score, rot, axis=1)
+        order_rot = jnp.argsort(-score_rot, axis=1)
+        order_n = jnp.take_along_axis(rot, order_rot, axis=1)  # [SC, N]
         feas_sorted = jnp.take_along_axis(mask, order_n, axis=1)
         allowed = _domain_quota_pass(
             tables, cyc, state, mask, order_n, feas_sorted)
@@ -323,74 +387,129 @@ def assign_waves(
         A = jnp.zeros((SC, N), bool).at[
             jnp.arange(SC)[:, None], order_n].set(adm_sorted)
 
-        # per-node cross-class resolution in queue-rank order
+        # per-node cross-class resolution in queue-rank order, as a scan
+        # over CLASS BLOCKS: the cumulative passes need [block, N, …]
+        # temporaries only, never [SC, N, R] — at thousands of distinct
+        # classes (gang jobs each carry their own labels → their own class)
+        # the un-blocked cumsum chain was an HBM-OOM worker crash at
+        # 5k nodes × 100k pods. Carries thread the exact same exclusive
+        # prefixes across blocks, so the result is bit-identical.
         cord = rank_key                                       # [SC] perm
         A_ord = A[cord]
         req_ord = req_by_class[cord]                          # [SC, R]
-        add = jnp.where(A_ord[:, :, None], req_ord[:, None, :], 0)
-        cum_exc = jnp.cumsum(add, axis=0) - add               # [SC, N, R]
-        # earlier same-wave classes consume free space; the pod itself must
-        # fit per PodFitsResources semantics (zero scalar requests ignore
-        # that scalar's free — fit._fit, predicates.go:800-845)
-        free = nodes.alloc[None] - state.used[None] - cum_exc
-        fits = _fit(req_ord[:, None, :], free)
-        keep = A_ord & fits
-
         ps_ord = classes.portset[cord]
         psafe = jnp.maximum(ps_ord, 0)
         has_p = (ps_ord >= 0)
         pairw = tables.portsets.pair_words[psafe]             # [SC, PWp]
         wildw = tables.portsets.wild_words[psafe]
         tripw = tables.portsets.trip_words[psafe]
-        kp = (keep & has_p[:, None])[:, :, None]
-        scan_or = lambda W: lax.associative_scan(
-            jnp.bitwise_or, jnp.where(kp, W[:, None, :], 0), axis=0)
-        inc_p, inc_w, inc_t = scan_or(pairw), scan_or(wildw), scan_or(tripw)
-        shift = lambda M: jnp.concatenate(
-            [jnp.zeros_like(M[:1]), M[:-1]], axis=0)
-        exc_p, exc_w, exc_t = shift(inc_p), shift(inc_w), shift(inc_t)
-        conflict = (
-            ((wildw[:, None, :] & exc_p) != 0)
-            | ((pairw[:, None, :] & exc_w) != 0)
-            | ((tripw[:, None, :] & exc_t) != 0)
-        ).any(-1)
-        keep = keep & (~has_p[:, None] | ~conflict)
-
-        # volume conflict/limits against same-wave earlier classes on the
-        # same node (the per-node cumulative pass, like ports): exclusive-
-        # prefix OR of volume words, then re-check conflict + attach limits
         vs_ord = classes.volset[cord]
         vsafe = jnp.maximum(vs_ord, 0)
         has_v = (vs_ord >= 0)
         vanyw = tables.volsets.any_words[vsafe]               # [SC, VW]
         vrww = tables.volsets.rw_words[vsafe]
-        kv = (keep & has_v[:, None])[:, :, None]
-        scan_orv = lambda W: lax.associative_scan(
-            jnp.bitwise_or, jnp.where(kv, W[:, None, :], 0), axis=0)
-        exc_va, exc_vr = (shift(scan_orv(vanyw)), shift(scan_orv(vrww)))
-        tot_any = state.vol_any[None] | exc_va                # [SC, N, VW]
-        tot_rw = state.vol_rw[None] | exc_vr
-        vconf = (
-            ((vanyw[:, None, :] & tot_rw) != 0)
-            | ((vrww[:, None, :] & tot_any) != 0)
-        ).any(-1)
-        after_v = tot_any | vanyw[:, None, :]
-        vcnt = jax.lax.population_count(
-            after_v[:, :, None, :] & tables.drv_masks[None, None, :, :]
-        ).sum(-1).astype(jnp.int32)                           # [SC, N, DR]
-        vlim = nodes.vol_limit[None]                          # [1, N, DR]
-        vlim_ok = ((vlim < 0) | (vcnt <= vlim)).all(-1)
-        keep = keep & (~has_v[:, None] | (~vconf & vlim_ok))
 
-        # committed port + volume words (kept classes only)
-        kp2 = (keep & has_p[:, None])[:, :, None]
-        or_last = lambda W: lax.associative_scan(
-            jnp.bitwise_or, jnp.where(kp2, W[:, None, :], 0), axis=0)[-1]
-        orp, orw, ort = or_last(pairw), or_last(wildw), or_last(tripw)
-        kv2 = (keep & has_v[:, None])[:, :, None]
-        or_lastv = lambda W: lax.associative_scan(
-            jnp.bitwise_or, jnp.where(kv2, W[:, None, :], 0), axis=0)[-1]
-        orva, orvr = or_lastv(vanyw), or_lastv(vrww)
+        B = min(_CONTENTION_BLOCK, SC)
+        nb = -(-SC // B)
+        pad = nb * B - SC
+
+        def blocks_of(x):  # pad with inert rows (no admission, zero words)
+            if pad:
+                z = jnp.zeros((pad,) + x.shape[1:], x.dtype)
+                x = jnp.concatenate([x, z])
+            return x.reshape((nb, B) + x.shape[1:])
+
+        shift = lambda M: jnp.concatenate(
+            [jnp.zeros_like(M[:1]), M[:-1]], axis=0)
+        or_red = lambda k, W: lax.associative_scan(
+            jnp.bitwise_or, jnp.where(k, W[:, None, :], 0), axis=0)[-1]
+
+        def block(carry, xs):
+            cum_used, c_pa, c_pw, c_pt, c_va, c_vr = carry
+            A_b, req_b, hp_b, pw_b, ww_b, tw_b, hv_b, va_b, vr_b = xs
+            add = jnp.where(A_b[:, :, None], req_b[:, None, :], 0)
+            cum_exc = (jnp.cumsum(add, axis=0) - add) + cum_used[None]
+            # earlier same-wave classes consume free space; the pod itself
+            # must fit per PodFitsResources semantics (zero scalar requests
+            # ignore that scalar's free — fit._fit, predicates.go:800-845)
+            free = nodes.alloc[None] - state.used[None] - cum_exc
+            fits = _fit(req_b[:, None, :], free)
+            keep = A_b & fits
+
+            # ports: exclusive prefix over keep-after-resources (a class
+            # that itself loses the port check still shadows later ones —
+            # conservative, matching the un-blocked pass)
+            kp = (keep & hp_b[:, None])[:, :, None]
+            scan_or = lambda W: lax.associative_scan(
+                jnp.bitwise_or, jnp.where(kp, W[:, None, :], 0), axis=0)
+            inc_p, inc_w, inc_t = scan_or(pw_b), scan_or(ww_b), scan_or(tw_b)
+            exc_p = shift(inc_p) | c_pa[None]
+            exc_w = shift(inc_w) | c_pw[None]
+            exc_t = shift(inc_t) | c_pt[None]
+            conflict = (
+                ((ww_b[:, None, :] & exc_p) != 0)
+                | ((pw_b[:, None, :] & exc_w) != 0)
+                | ((tw_b[:, None, :] & exc_t) != 0)
+            ).any(-1)
+            keep2 = keep & (~hp_b[:, None] | ~conflict)
+
+            # volume conflict/limits against same-wave earlier classes on
+            # the same node: exclusive-prefix OR, then conflict + limits
+            kv = (keep2 & hv_b[:, None])[:, :, None]
+            scan_orv = lambda W: lax.associative_scan(
+                jnp.bitwise_or, jnp.where(kv, W[:, None, :], 0), axis=0)
+            exc_va = shift(scan_orv(va_b)) | c_va[None]
+            exc_vr = shift(scan_orv(vr_b)) | c_vr[None]
+            tot_any = state.vol_any[None] | exc_va            # [B, N, VW]
+            tot_rw = state.vol_rw[None] | exc_vr
+            vconf = (
+                ((va_b[:, None, :] & tot_rw) != 0)
+                | ((vr_b[:, None, :] & tot_any) != 0)
+            ).any(-1)
+            after_v = tot_any | va_b[:, None, :]
+            vcnt = jax.lax.population_count(
+                after_v[:, :, None, :] & tables.drv_masks[None, None, :, :]
+            ).sum(-1).astype(jnp.int32)                       # [B, N, DR]
+            vlim = nodes.vol_limit[None]                      # [1, N, DR]
+            vlim_ok = ((vlim < 0) | (vcnt <= vlim)).all(-1)
+            keep3 = keep2 & (~hv_b[:, None] | (~vconf & vlim_ok))
+
+            # carries: resources advance over A_b (pre-filter, as above);
+            # port words over keep-after-resources; volume words over
+            # keep-after-ports. Committed words (state update) come from
+            # the FINAL keep and are emitted per block.
+            carry2 = (
+                cum_used + add.sum(axis=0),
+                c_pa | inc_p[-1], c_pw | inc_w[-1], c_pt | inc_t[-1],
+                c_va | scan_orv(va_b)[-1], c_vr | scan_orv(vr_b)[-1],
+            )
+            kp2 = (keep3 & hp_b[:, None])[:, :, None]
+            kv2 = (keep3 & hv_b[:, None])[:, :, None]
+            committed = (
+                or_red(kp2, pw_b), or_red(kp2, ww_b), or_red(kp2, tw_b),
+                or_red(kv2, va_b), or_red(kv2, vr_b),
+            )
+            return carry2, (keep3, committed)
+
+        Wp = pairw.shape[1]
+        VW = vanyw.shape[1]
+        carry0 = (
+            jnp.zeros((N, R), jnp.int32),
+            jnp.zeros((N, Wp), pairw.dtype),
+            jnp.zeros((N, Wp), wildw.dtype),
+            jnp.zeros((N, Wp), tripw.dtype),
+            jnp.zeros((N, VW), vanyw.dtype),
+            jnp.zeros((N, VW), vrww.dtype),
+        )
+        _, (keep_b, committed_b) = lax.scan(
+            block, carry0,
+            (blocks_of(A_ord), blocks_of(req_ord), blocks_of(has_p),
+             blocks_of(pairw), blocks_of(wildw), blocks_of(tripw),
+             blocks_of(has_v), blocks_of(vanyw), blocks_of(vrww)))
+        keep = keep_b.reshape(nb * B, N)[:SC]
+        or_blocks = lambda x: lax.associative_scan(
+            jnp.bitwise_or, x, axis=0)[-1]
+        orp, orw, ort, orva, orvr = (or_blocks(cb) for cb in committed_b)
 
         A_final = jnp.zeros_like(A).at[cord].set(keep)
         m = A_final.sum(axis=1).astype(jnp.int32)             # [SC]
@@ -424,11 +543,35 @@ def assign_waves(
                              (SC, N)).reshape(-1))
         wave_out2 = wave_out.at[pod_id.reshape(-1)].set(waves)
 
-        # zero-progress ⇒ state is frozen ⇒ the whole tier run of every
-        # attempting class fails exactly as it would pod-by-pod in the scan
+        # Failure consumption, two rules (both replay-sound):
+        #  * global zero progress ⇒ state is frozen ⇒ every attempting
+        #    class's priority run fails exactly as pod-by-pod in the scan;
+        #  * EARLY per-class fail: an attempted class whose Filter mask is
+        #    false on every node, ranked ahead of every class that admitted
+        #    this wave, consumes its run NOW — its pods replay before any
+        #    of this wave's placements, against exactly the wave-start
+        #    state that rejected them. (Filter-infeasible only: a class
+        #    losing to same-wave quota/contention retries next wave, where
+        #    the sequential outcome may differ.)
         fail = total == 0
-        consume = jnp.where(fail & attempted,
-                            jnp.minimum(tier_cnt, remaining), m)
+        infeasible = attempted & ~mask.any(axis=1)
+        # monotone classes consume EVERYTHING once nowhere-feasible (state
+        # never relaxes for them this dispatch). Non-monotone classes (a
+        # later placement could open nodes for them: required affinity,
+        # hard spread) consume only when they sit in the FAILING PREFIX of
+        # the rank order — every class ranked before them this wave is
+        # itself infeasible-attempted or inactive, so their sequential
+        # replay position pops against exactly the wave-start state that
+        # rejected them. (Ranked-behind a blocked or admitting class, they
+        # retry: that class's later placements may feed their predicates.)
+        ord_fail = (infeasible | ~nxt_ok)[rank_key]
+        prefix = jnp.cumprod(ord_fail.astype(jnp.int32)) > 0
+        in_prefix = jnp.zeros((SC,), bool).at[rank_key].set(prefix)
+        early_fail = infeasible & (mono | in_prefix)
+        run_left = jnp.minimum(run_cnt, remaining)
+        consume = jnp.where(infeasible & mono, remaining,
+                            jnp.where((fail & attempted) | early_fail,
+                                      run_left, m))
         return _WaveCarry(
             state=state2, cursor=cursor + consume, placed=placed + m,
             node_out=node_out2, wave_out=wave_out2, waves=waves + 1,

@@ -12,6 +12,7 @@ state/dims.py), so steady-state cycles pay one dispatch, zero recompiles.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -81,7 +82,7 @@ def _apply_extra_plugins(tables, cyc, extra_plugins, extra_weights):
         score=cyc.static.score + bias))
 
 
-@functools.partial(jax.jit, static_argnums=(3, 5, 8))
+@functools.partial(jax.jit, static_argnums=(3, 5, 8, 11))
 def _schedule_batch_impl(
     tables: ClusterTables,
     pending: PodArrays,
@@ -94,7 +95,8 @@ def _schedule_batch_impl(
     extra_plugins: tuple = (),
     extra_weights: tuple = (),
     gang=None,
-) -> AssignResult:
+    return_waves: bool = False,
+):
     from ..ops.gang import assign_gang
     from ..ops.waves import assign_waves
 
@@ -108,10 +110,86 @@ def _schedule_batch_impl(
         res, _ = assign_gang(
             tables, cyc, pending, init, gang,
             engine_fn=assign_batch if engine == "scan" else None)
-        return res
+        return (res, None) if return_waves else res
     if engine == "scan":
-        return assign_batch(tables, cyc, pending, init)
+        res = assign_batch(tables, cyc, pending, init)
+        return (res, None) if return_waves else res
+    if return_waves:
+        # bench/profiling: per-pod admission-wave indices ride along so the
+        # driver can report wave counts without a second dispatch
+        return assign_waves(tables, cyc, pending, init, return_waves=True)
     return assign_waves(tables, cyc, pending, init)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 7))
+def _gang_round_impl(tables, pending, keys, D, existing,
+                     hard_weight, ecfg, extra_plugins, extra_weights,
+                     gang, rejected):
+    """One gang round as its own dispatch: wave fixpoint over the batch with
+    `rejected` groups' pods masked out, plus the per-group fill counts the
+    host rejection policy consumes. See `_schedule_gang_host_rounds`."""
+    from ..ops.gang import _placed_per_group
+    from ..ops.waves import assign_waves
+
+    uk, ev = keys
+    cyc = build_cycle(tables, existing, uk, ev, D, hard_weight, ecfg)
+    cyc = _apply_extra_plugins(tables, cyc, extra_plugins, extra_weights)
+    init = initial_state(tables, cyc)
+    GR = gang.needed.shape[0]
+    ok = (gang.group < 0) | ~rejected[jnp.clip(gang.group, 0, GR - 1)]
+    masked = pending._replace(valid=pending.valid & ok)
+    res, waves = assign_waves(tables, cyc, masked, init, return_waves=True)
+    placed = _placed_per_group(gang, masked, res.feasible)
+    under = gang.valid & ~rejected & (placed < gang.needed)
+    return res, waves, placed, under
+
+
+# device-loop gang programs above this batch size run as HOST-driven rounds:
+# a single XLA execution carrying GR+2 wave fixpoints runs for minutes at
+# the 5k×100k shape and trips the TPU runtime's execution watchdog (worker
+# 'crash'); one dispatch per round keeps each execution bounded while the
+# fixpoint itself stays on device (≤ GR+2 extra host round-trips total)
+_GANG_HOST_THRESHOLD = int(os.environ.get(
+    "KTPU_GANG_HOST_ROUNDS_ABOVE", "65536"))
+
+
+def _schedule_gang_host_rounds(tables, pending, keys, D, existing,
+                               hard_weight, ecfg, extra_plugins,
+                               extra_weights, gang, soft_rounds=4):
+    """Host-driven mirror of ops/gang.py assign_gang's rejection policy:
+    zero-placed underfilled groups reject in bulk, partially-filled ones one
+    per round (lowest rank first) until `soft_rounds`, then in bulk."""
+    import numpy as np
+
+    GR = int(gang.needed.shape[0])
+    rank = np.asarray(jax.device_get(gang.rank))
+    rejected = np.zeros((GR,), bool)
+    rounds = 0
+    while True:
+        res, waves, placed_d, under_d = _gang_round_impl(
+            tables, pending, keys, D, existing,
+            jnp.float32(hard_weight), ecfg or default_engine_config(),
+            extra_plugins, extra_weights, gang, jnp.asarray(rejected))
+        under = np.asarray(jax.device_get(under_d))
+        placed = np.asarray(jax.device_get(placed_d))
+        rounds += 1
+        if not under.any() or rounds >= GR + 2:
+            break
+        zero = under & (placed == 0)
+        partial = under & (placed > 0)
+        if rounds > soft_rounds or not partial.any():
+            newly = zero | partial
+        else:
+            worst = int(np.argmax(np.where(partial, rank, -1)))
+            newly = zero.copy()
+            newly[worst] = True
+        rejected |= newly
+    dead = rejected | under
+    GRc = jnp.clip(gang.group, 0, GR - 1)
+    ok = (gang.group < 0) | ~jnp.asarray(dead)[GRc]
+    res = AssignResult(node=jnp.where(ok, res.node, -1),
+                       feasible=res.feasible & ok, state=res.state)
+    return res, waves
 
 
 def _schedule_batch(tables, pending, keys, D, existing,
@@ -120,8 +198,15 @@ def _schedule_batch(tables, pending, keys, D, existing,
                     ecfg=None,
                     extra_plugins: tuple = (),
                     extra_weights: tuple = (),
-                    gang=None) -> AssignResult:
+                    gang=None,
+                    return_waves: bool = False):
     engine = _engine()
+    if gang is not None and engine != "scan" and not has_node_name \
+            and pending.valid.shape[0] >= _GANG_HOST_THRESHOLD:
+        out = _schedule_gang_host_rounds(
+            tables, pending, keys, D, existing, hard_weight, ecfg,
+            extra_plugins, extra_weights, gang)
+        return out if return_waves else out[0]
     if engine != "scan" and has_node_name:
         # spec.nodeName pods carry a per-POD (not per-class) host constraint
         # the class-granular wave path cannot express; in the reference such
@@ -136,7 +221,8 @@ def _schedule_batch(tables, pending, keys, D, existing,
     return _schedule_batch_impl(tables, pending, keys, D, existing, engine,
                                 jnp.float32(hard_weight),
                                 ecfg or default_engine_config(),
-                                extra_plugins, extra_weights, gang)
+                                extra_plugins, extra_weights, gang,
+                                return_waves)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))

@@ -53,10 +53,14 @@ class Dims:
     TT: int = 4       # taints per node
     PP: int = 4       # host ports per pod
     AT: int = 2       # required pod-affinity terms per pod
-    AN: int = 2       # required pod-anti-affinity terms per pod
+    # AN and TS floors are 1, not 2: each slot is a full vmapped
+    # quota family in the wave engine (ops/waves.py _domain_quota_pass —
+    # an [N] sort per class per slot per wave), so an unused second slot
+    # is pure device time; workloads with 2+ constraints grow the bucket
+    AN: int = 1       # required pod-anti-affinity terms per pod
     PAT: int = 2      # preferred pod-affinity terms per pod
     PAN: int = 2      # preferred pod-anti-affinity terms per pod
-    TS: int = 2       # topology-spread constraints per pod
+    TS: int = 1       # topology-spread constraints per pod
     SS: int = 2       # SelectorSpread owner selectors per pod
     CI: int = 4       # container images per pod (ImageLocality)
     IMG: int = 8      # interned container images

@@ -336,6 +336,13 @@ class Encoder:
         ent = self._pod_rows.get(id(p))
         if ent is not None and ent[0] is p:
             return ent[1]
+        if p.pod_group:
+            # groups must be interned at INGEST time so dims() sees the true
+            # group count before capacities freeze: computing GR only inside
+            # build_gang_arrays left the first cycle at the default GR
+            # bucket, and gang ids beyond it clip-collided (wrong all-or-
+            # nothing accounting for every group past the capacity)
+            self.group_id(p)
         row = (
             self.vocabs.pod_names.intern(p.name),
             self.vocabs.namespaces.intern(p.namespace),
@@ -778,13 +785,19 @@ class Encoder:
         rows[:, 0] = rows[:, 1] = rows[:, 5] = -1  # absent ids, like before
         if k:
             # one vectorized assembly from memoized rows — 50k pods cost one
-            # numpy copy, not 50k spec walks (pod_row pays the walk exactly
-            # once per pod object, at informer-arrival time in steady state)
-            rows[:k] = np.array([self.pod_row(p) for p in pods], I32)
+            # flat fromiter, not 50k spec walks (pod_row pays the walk
+            # exactly once per pod object, at informer-arrival time in
+            # steady state). fromiter over the flattened generator skips the
+            # list-of-tuples + sequence-protocol copy np.array would do —
+            # this assembly is the largest host-side term of the steady
+            # cycle at 50k pending.
+            rows[:k] = np.fromiter(
+                (v for p in pods for v in self.pod_row(p)),
+                dtype=I32, count=6 * k).reshape(k, 6)
             valid[:k] = True
-            nid = [node_index.get(p.node_name, -1) if p.node_name else -1
-                   for p in pods]
-            node_id[:k] = np.array(nid, I32)
+            node_id[:k] = np.fromiter(
+                (node_index.get(p.node_name, -1) if p.node_name else -1
+                 for p in pods), dtype=I32, count=k)
         return PodArrays(
             valid=valid, name_id=rows[:, 0], ns=rows[:, 1], cls=rows[:, 2],
             priority=rows[:, 3], creation=rows[:, 4],

@@ -78,6 +78,32 @@ def test_waves_match_scan_homogeneous():
         np.asarray(wave_res.state.used), np.asarray(scan_res.state.used))
 
 
+def test_singleton_high_class_index_ties_match_scan():
+    """A single pending class must use tie-rotation offset 0 even when its
+    interned class INDEX is nonzero (other classes exist from bound pods):
+    the offset keys on queue rank within the batch, not the global class id
+    (code-review regression — uniform nodes, all scores tied, waves must
+    pick the scan's lowest-index node)."""
+    nodes = [Node(name=f"n{i}",
+                  allocatable=Resources.make(cpu="8", memory="16Gi",
+                                             pods=110))
+             for i in range(8)]
+    # two bound pods with distinct specs intern classes 0 and 1 first
+    existing = [
+        Pod(name="e0", requests=Resources.make(cpu="1", memory="1Gi"),
+            node_name="n5", creation_index=0),
+        Pod(name="e1", requests=Resources.make(cpu="2", memory="2Gi"),
+            node_name="n6", creation_index=1),
+    ]
+    pending = [Pod(name="p", labels={"fresh": "yes"},
+                   requests=Resources.make(cpu="500m", memory="512Mi"),
+                   creation_index=10)]
+    tables, ex, pe, uk, ev, d = _encode(nodes, existing, pending)
+    res_w, _ = _run("waves", tables, ex, pe, uk, ev, d.D)
+    res_s, _ = _run("scan", tables, ex, pe, uk, ev, d.D)
+    assert int(np.asarray(res_w.node)[0]) == int(np.asarray(res_s.node)[0])
+
+
 def test_waves_respect_priority_tiers():
     """A higher-priority pod must win the last slot on a nearly-full node
     (activeQ order: priority desc — scheduling_queue.go:119-138)."""
