@@ -117,14 +117,18 @@ def default_cronjob(o: Obj) -> None:
 
 
 def validate_pod(o: Obj) -> List[str]:
-    errs = []
-    spec = o.get("spec", {})
-    if not spec.get("containers"):
-        errs.append("spec.containers: Required value")
-    for c in spec.get("containers", []) or []:
-        if not c.get("name"):
-            errs.append("spec.containers[].name: Required value")
-    return errs
+    # the full core-validation corpus (api/validation.py — the
+    # pkg/apis/core/validation seat): metadata grammar, containers,
+    # resources, ports, tolerations, affinity weights, spread constraints
+    from kubernetes_tpu.api.validation import validate_pod as _vp
+
+    return _vp(o)
+
+
+def validate_node_full(o: Obj) -> List[str]:
+    from kubernetes_tpu.api.validation import validate_node as _vn
+
+    return _vn(o)
 
 
 def validate_selector_matches_template(o: Obj) -> List[str]:
@@ -190,7 +194,7 @@ def build_scheme() -> Scheme:
                  defaulter=default_pod, validator=validate_pod))
     s.register(R("", "v1", "Node", "nodes", namespaced=False,
                  short_names=("no",), subresources=("status",),
-                 defaulter=default_node))
+                 defaulter=default_node, validator=validate_node_full))
     s.register(R("", "v1", "Namespace", "namespaces", namespaced=False,
                  short_names=("ns",), subresources=("status", "finalize"),
                  defaulter=default_namespace))

@@ -159,8 +159,10 @@ def assign_gang(
         waves=jnp.full((P,), -1, jnp.int32),
         state=init))
 
-    # the loop always exits with `under` empty (each round rejects ≥1 group,
-    # capped at GR+1); the strip below also covers the unreachable cap exit
+    # the loop always exits with `under` empty (after the initial round,
+    # each iteration rejects ≥1 group; rounds cap at GR+2 counting the
+    # dummy-carry first iteration); the strip below also covers the
+    # unreachable cap exit
     dead = final.rejected | final.under
     ok = (gang.group < 0) | ~dead[jnp.clip(gang.group, 0, GR - 1)]
     result = AssignResult(node=jnp.where(ok, final.node, -1),

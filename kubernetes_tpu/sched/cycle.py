@@ -107,6 +107,10 @@ def _schedule_batch_impl(
     if gang is not None:
         # group-atomic admission (ops/gang.py); gang=None traces the plain
         # engines, so gang-free batches compile/run exactly as before
+        if return_waves and engine != "scan":
+            res, _, waves = assign_gang(tables, cyc, pending, init, gang,
+                                        return_waves=True)
+            return res, waves
         res, _ = assign_gang(
             tables, cyc, pending, init, gang,
             engine_fn=assign_batch if engine == "scan" else None)

@@ -64,7 +64,7 @@ class TestRegistry:
              "spec": {"replicas": 2,
                       "selector": {"matchLabels": {"app": "web"}},
                       "template": {"metadata": {"labels": {"app": "web"}},
-                                   "spec": {"containers": [{"name": "c"}]}}}}
+                                   "spec": {"containers": [{"name": "c", "image": "i"}]}}}}
         created = deploys.create("default", d)
         assert created["metadata"]["generation"] == 1
         # controller writes status
@@ -155,7 +155,7 @@ class TestSubresources:
             "metadata": {"name": "web", "namespace": "default"},
             "spec": {"replicas": 1, "selector": {"matchLabels": {"a": "b"}},
                      "template": {"metadata": {"labels": {"a": "b"}},
-                                  "spec": {"containers": [{"name": "c"}]}}}})
+                                  "spec": {"containers": [{"name": "c", "image": "i"}]}}}})
         sc = api.get_scale("apps", "deployments", "default", "web")
         assert sc["spec"]["replicas"] == 1 and sc["kind"] == "Scale"
         api.put_scale("apps", "deployments", "default", "web",
@@ -227,7 +227,7 @@ class TestHTTP:
              "metadata": {"name": "web"},
              "spec": {"selector": {"matchLabels": {"a": "b"}},
                       "template": {"metadata": {"labels": {"a": "b"}},
-                                   "spec": {"containers": [{"name": "c"}]}}}}
+                                   "spec": {"containers": [{"name": "c", "image": "i"}]}}}}
         code, out = self._req(gw, "POST",
                               "/apis/apps/v1/namespaces/default/deployments", d)
         assert code == 201 and out["spec"]["replicas"] == 1  # defaulted
@@ -286,7 +286,7 @@ class TestUpdateValidation:
             "metadata": {"name": "v", "namespace": "default"},
             "spec": {"selector": {"matchLabels": {"a": "b"}},
                      "template": {"metadata": {"labels": {"a": "b"}},
-                                  "spec": {"containers": [{"name": "c"}]}}}})
+                                  "spec": {"containers": [{"name": "c", "image": "i"}]}}}})
         bad = dict(d)
         bad["spec"] = {"replicas": 1, "template": d["spec"]["template"]}
         with pytest.raises(errors.StatusError) as ei:

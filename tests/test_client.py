@@ -287,7 +287,7 @@ class TestControllerRestart:
               "spec": {"replicas": 1,
                        "selector": {"matchLabels": {"app": "revive"}},
                        "template": {"metadata": {"labels": {"app": "revive"}},
-                                    "spec": {"containers": [{"name": "c"}]}}}}
+                                    "spec": {"containers": [{"name": "c", "image": "i"}]}}}}
         client.replicasets.create(rs)
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:

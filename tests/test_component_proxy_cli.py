@@ -422,18 +422,30 @@ class TestReviewRegressions:
         assert proxier.table.lookup("10.96.0.40", 80) == "10.3.0.1:8080"
 
     def test_label_value_ending_in_dash(self, api):
+        """A kv entry containing '=' is an ASSIGNMENT even when the value
+        ends in '-' (the parser regression: it must not be misread as a
+        removal); the server then applies the reference's label-value
+        grammar, which rejects the trailing dash (validation.go
+        IsValidLabelValue) — so the assignment travels as an assignment
+        and fails as a 422, never silently removes."""
         gw = HTTPGateway(api).start()
         try:
             client = Client.http(gw.url)
             client.pods.create({
                 "apiVersion": "v1", "kind": "Pod",
                 "metadata": {"name": "lbl", "namespace": "default"},
-                "spec": {"containers": [{"name": "c"}]}})
-            out = io.StringIO()
+                "spec": {"containers": [{"name": "c", "image": "i"}]}})
+            out, err = io.StringIO(), io.StringIO()
             assert kubectl_main(["-s", gw.url, "label", "pods", "lbl",
-                                 "branch=feature-x-"], out=out) == 0
+                                 "branch=feature-x-"], out=out,
+                                err=err) == 1
+            assert "Invalid" in err.getvalue()
+            assert client.pods.get("lbl")["metadata"].get("labels", {}) == {}
+            # valid value assigns; a '-'-suffixed bare key removes
+            assert kubectl_main(["-s", gw.url, "label", "pods", "lbl",
+                                 "branch=feature-x"], out=out) == 0
             assert client.pods.get("lbl")["metadata"]["labels"] == {
-                "branch": "feature-x-"}
+                "branch": "feature-x"}
             assert kubectl_main(["-s", gw.url, "label", "pods", "lbl",
                                  "branch-"], out=out) == 0
             assert client.pods.get("lbl")["metadata"].get("labels", {}) == {}
@@ -451,7 +463,7 @@ class TestReviewRegressions:
             "apiVersion": "v1", "kind": "Pod",
             "metadata": {"name": "m1", "namespace": "default",
                          "labels": {"app": "multi"}},
-            "spec": {"containers": [{"name": "c"}]}})
+            "spec": {"containers": [{"name": "c", "image": "i"}]}})
         import pytest as _pytest
         from kubernetes_tpu.machinery import errors as merrors
         with _pytest.raises(merrors.StatusError) as ei:

@@ -78,7 +78,7 @@ class TestReplicaSet:
               "spec": {"replicas": 3,
                        "selector": {"matchLabels": {"app": "rs1"}},
                        "template": {"metadata": {"labels": {"app": "rs1"}},
-                                    "spec": {"containers": [{"name": "c"}]}}}}
+                                    "spec": {"containers": [{"name": "c", "image": "i"}]}}}}
         client.replicasets.create(rs)
         assert wait_for(lambda: len(client.pods.list(
             "default", label_selector="app=rs1")["items"]) == 3)
@@ -97,7 +97,7 @@ class TestReplicaSet:
               "spec": {"replicas": 2,
                        "selector": {"matchLabels": {"app": "rs2"}},
                        "template": {"metadata": {"labels": {"app": "rs2"}},
-                                    "spec": {"containers": [{"name": "c"}]}}}}
+                                    "spec": {"containers": [{"name": "c", "image": "i"}]}}}}
         client.replicasets.create(rs)
         assert wait_for(lambda: len(client.pods.list(
             "default", label_selector="app=rs2")["items"]) == 2)
@@ -151,7 +151,7 @@ class TestJob:
                "metadata": {"name": "sum", "namespace": "default"},
                "spec": {"completions": 2, "parallelism": 2,
                         "template": {"metadata": {"labels": {"job": "sum"}},
-                                     "spec": {"containers": [{"name": "c"}],
+                                     "spec": {"containers": [{"name": "c", "image": "i"}],
                                               "restartPolicy": "Never"}}}}
         client.jobs.create(job)
         assert wait_for(lambda: len(client.pods.list(
@@ -170,7 +170,7 @@ class TestJob:
                "metadata": {"name": "boom", "namespace": "default"},
                "spec": {"completions": 1, "parallelism": 1, "backoffLimit": 0,
                         "template": {"metadata": {"labels": {"job": "boom"}},
-                                     "spec": {"containers": [{"name": "c"}],
+                                     "spec": {"containers": [{"name": "c", "image": "i"}],
                                               "restartPolicy": "Never"}}}}
         client.jobs.create(job)
         assert wait_for(lambda: len(client.pods.list(
@@ -191,7 +191,7 @@ class TestStatefulSet:
               "spec": {"replicas": 3, "serviceName": "db",
                        "selector": {"matchLabels": {"app": "db"}},
                        "template": {"metadata": {"labels": {"app": "db"}},
-                                    "spec": {"containers": [{"name": "c"}]}}}}
+                                    "spec": {"containers": [{"name": "c", "image": "i"}]}}}}
         client.statefulsets.create(ss)
         # OrderedReady: db-0 first, db-1 only after db-0 Ready
         assert wait_for(lambda: client.pods.list(
@@ -223,7 +223,7 @@ class TestDaemonSet:
               "metadata": {"name": "agent", "namespace": "default"},
               "spec": {"selector": {"matchLabels": {"app": "agent"}},
                        "template": {"metadata": {"labels": {"app": "agent"}},
-                                    "spec": {"containers": [{"name": "c"}]}}}}
+                                    "spec": {"containers": [{"name": "c", "image": "i"}]}}}}
         client.daemonsets.create(ds)
 
         def placed():
@@ -246,7 +246,7 @@ class TestEndpointsAndServices:
             "apiVersion": "v1", "kind": "Pod",
             "metadata": {"name": "w1", "namespace": "default",
                          "labels": {"app": "web"}},
-            "spec": {"containers": [{"name": "c"}], "nodeName": "n1"}})
+            "spec": {"containers": [{"name": "c", "image": "i"}], "nodeName": "n1"}})
         mark_pods_running(client, selector="app=web")
         assert wait_for(lambda: (client.endpoints.get("web")
                                  .get("subsets") or [{}])[0].get("addresses"))
@@ -271,7 +271,7 @@ class TestEndpointSlices:
                 "apiVersion": "v1", "kind": "Pod",
                 "metadata": {"name": f"{app}-{i}", "namespace": "default",
                              "labels": {"app": app}},
-                "spec": {"containers": [{"name": "c"}], "nodeName": "n1"}})
+                "spec": {"containers": [{"name": "c", "image": "i"}], "nodeName": "n1"}})
         mark_pods_running(client, selector=f"app={app}")
 
     def _owned(self, client, svc):
@@ -327,7 +327,7 @@ class TestNamespaceLifecycle:
                                   "metadata": {"name": "team"}})
         client.pods.create({"apiVersion": "v1", "kind": "Pod",
                             "metadata": {"name": "p", "namespace": "team"},
-                            "spec": {"containers": [{"name": "c"}]}})
+                            "spec": {"containers": [{"name": "c", "image": "i"}]}})
         api.delete_namespace("team")
         assert wait_for(lambda: not _exists(client.namespaces, "team", ""))
         assert client.pods.list("team")["items"] == []
@@ -340,7 +340,7 @@ class TestGCAndPodGC:
               "spec": {"replicas": 2,
                        "selector": {"matchLabels": {"app": "short"}},
                        "template": {"metadata": {"labels": {"app": "short"}},
-                                    "spec": {"containers": [{"name": "c"}]}}}}
+                                    "spec": {"containers": [{"name": "c", "image": "i"}]}}}}
         client.replicasets.create(rs)
         assert wait_for(lambda: len(client.pods.list(
             "default", label_selector="app=short")["items"]) == 2)
@@ -352,7 +352,7 @@ class TestGCAndPodGC:
         client.pods.create({
             "apiVersion": "v1", "kind": "Pod",
             "metadata": {"name": "ghost", "namespace": "default"},
-            "spec": {"containers": [{"name": "c"}], "nodeName": "gone-node"}})
+            "spec": {"containers": [{"name": "c", "image": "i"}], "nodeName": "gone-node"}})
         assert wait_for(lambda: not _exists(client.pods, "ghost"), timeout=15)
 
 
@@ -372,7 +372,7 @@ class TestNodeLifecycle:
         client.pods.create({
             "apiVersion": "v1", "kind": "Pod",
             "metadata": {"name": "victim", "namespace": "default"},
-            "spec": {"containers": [{"name": "c"}], "nodeName": "n1"}})
+            "spec": {"containers": [{"name": "c", "image": "i"}], "nodeName": "n1"}})
         time.sleep(0.4)
         nlc.poll_once()  # fresh heartbeat: nothing happens
         assert "taints" not in client.nodes.get("n1", "").get("spec", {})
@@ -414,7 +414,7 @@ class TestDisruptionAndQuota:
                 "apiVersion": "v1", "kind": "Pod",
                 "metadata": {"name": f"g{i}", "namespace": "default",
                              "labels": {"app": "guarded"}},
-                "spec": {"containers": [{"name": "c"}]}})
+                "spec": {"containers": [{"name": "c", "image": "i"}]}})
         mark_pods_running(client, selector="app=guarded")
         assert wait_for(lambda: client.poddisruptionbudgets.get("pdb")
                         .get("status", {}).get("disruptionsAllowed") == 1)
@@ -428,7 +428,8 @@ class TestDisruptionAndQuota:
             "apiVersion": "v1", "kind": "Pod",
             "metadata": {"name": "qp", "namespace": "default"},
             "spec": {"containers": [{
-                "name": "c", "resources": {"requests": {"cpu": "500m"}}}]}})
+                "name": "c", "image": "i",
+                "resources": {"requests": {"cpu": "500m"}}}]}})
         assert wait_for(lambda: client.resourcequotas.get("q")
                         .get("status", {}).get("used", {}).get("pods") == "1")
         used = client.resourcequotas.get("q")["status"]["used"]
@@ -448,7 +449,7 @@ class TestCronJob:
             "metadata": {"name": "tick", "namespace": "default"},
             "spec": {"schedule": "@every 60s",
                      "jobTemplate": {"spec": {
-                         "template": {"spec": {"containers": [{"name": "c"}],
+                         "template": {"spec": {"containers": [{"name": "c", "image": "i"}],
                                                "restartPolicy": "Never"}}}}}})
         time.sleep(0.3)
         fake_now[0] = 61.0

@@ -159,7 +159,7 @@ class TestScheme:
     def test_default_validate_roundtrip(self):
         s = self._scheme()
         pod = {"apiVersion": "v1", "kind": "Pod",
-               "metadata": {"name": "p"}, "spec": {"containers": [{"name": "c"}]}}
+               "metadata": {"name": "p"}, "spec": {"containers": [{"name": "c", "image": "i"}]}}
         s.default(pod)
         assert pod["spec"]["schedulerName"] == "default-scheduler"
         s.validate(pod)  # passes

@@ -1,0 +1,154 @@
+"""Core-type validation corpus (api/validation.py — the
+pkg/apis/core/validation seat): grammar tables, pod/node rules, and the 422
+behavior through the live registry."""
+
+import pytest
+
+from kubernetes_tpu.api import validation as v
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.machinery import errors
+
+
+class TestGrammar:
+    @pytest.mark.parametrize("s,ok", [
+        ("abc", True), ("a-b-c", True), ("a1", True), ("1a", True),
+        ("", False), ("-abc", False), ("abc-", False), ("aBc", False),
+        ("a_b", False), ("a" * 63, True), ("a" * 64, False),
+    ])
+    def test_dns1123_label(self, s, ok):
+        assert v.is_dns1123_label(s) == ok
+
+    @pytest.mark.parametrize("s,ok", [
+        ("abc.def", True), ("a.b.c", True), ("abc", True),
+        ("a..b", False), (".abc", False), ("abc.", False),
+        ("a" * 253, True), ("a" * 254, False),
+    ])
+    def test_dns1123_subdomain(self, s, ok):
+        assert v.is_dns1123_subdomain(s) == ok
+
+    @pytest.mark.parametrize("s,ok", [
+        ("app", True), ("app.kubernetes.io/name", True),
+        ("example.com/gpu", True), ("a_b-c.d", True),
+        ("", False), ("a/b/c", False), ("-lead", False),
+        ("UPPER", True), ("bad domain/x", False),
+        ("x" * 63, True), ("x" * 64, False),
+    ])
+    def test_qualified_name(self, s, ok):
+        assert v.is_qualified_name(s) == ok
+
+    @pytest.mark.parametrize("s,ok", [
+        ("", True), ("v1", True), ("has space", False), ("v" * 64, False),
+    ])
+    def test_label_value(self, s, ok):
+        assert v.is_label_value(s) == ok
+
+
+def pod(**spec_over):
+    spec = {"containers": [{"name": "c", "image": "img"}]}
+    spec.update(spec_over)
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "default"}, "spec": spec}
+
+
+class TestPodValidation:
+    def test_valid_pod_passes(self):
+        assert v.validate_pod(pod()) == []
+
+    def test_bad_name_and_labels(self):
+        p = pod()
+        p["metadata"]["name"] = "Not_Valid"
+        p["metadata"]["labels"] = {"ok": "fine", "bad key!": "x",
+                                   "k": "bad value!"}
+        errs = v.validate_pod(p)
+        assert any("metadata.name" in e for e in errs)
+        assert any("bad key!" in e for e in errs)
+        assert any("bad value!" in e for e in errs)
+
+    def test_duplicate_container_names(self):
+        p = pod(containers=[{"name": "c", "image": "i"},
+                            {"name": "c", "image": "i"}])
+        assert any("Duplicate" in e for e in v.validate_pod(p))
+
+    def test_port_range_and_protocol(self):
+        p = pod(containers=[{"name": "c", "image": "i",
+                             "ports": [{"containerPort": 0},
+                                       {"hostPort": 70000},
+                                       {"containerPort": 80,
+                                        "protocol": "ICMP"}]}])
+        errs = v.validate_pod(p)
+        assert sum("must be between 1 and 65535" in e for e in errs) == 2
+        assert any("protocol" in e for e in errs)
+
+    def test_requests_exceed_limits(self):
+        p = pod(containers=[{"name": "c", "image": "i",
+                             "resources": {"requests": {"cpu": "2"},
+                                           "limits": {"cpu": "1"}}}])
+        assert any("less than or equal to cpu limit" in e
+                   for e in v.validate_pod(p))
+
+    def test_malformed_quantity(self):
+        p = pod(containers=[{"name": "c", "image": "i",
+                             "resources": {"requests":
+                                           {"memory": "lots"}}}])
+        assert any("quantities" in e for e in v.validate_pod(p))
+
+    def test_restart_policy_and_tolerations(self):
+        p = pod(restartPolicy="Sometimes",
+                tolerations=[{"operator": "Exists", "value": "boom"},
+                             {"operator": "Matches"}])
+        errs = v.validate_pod(p)
+        assert any("restartPolicy" in e for e in errs)
+        assert any("must be empty when `operator` is 'Exists'" in e
+                   for e in errs)
+        assert any("Unsupported value: 'Matches'" in e for e in errs)
+
+    def test_spread_and_affinity_weight(self):
+        p = pod(topologySpreadConstraints=[{"maxSkew": 0}],
+                affinity={"podAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution":
+                    [{"weight": 500, "podAffinityTerm": {}}]}})
+        errs = v.validate_pod(p)
+        assert any("maxSkew" in e for e in errs)
+        assert any("topologyKey: Required" in e for e in errs)
+        assert any("range 1-100" in e for e in errs)
+
+
+class TestNodeValidation:
+    def test_valid_node(self):
+        n = {"metadata": {"name": "n0"},
+             "spec": {"taints": [{"key": "example.com/dedicated",
+                                  "value": "db", "effect": "NoSchedule"}]},
+             "status": {"capacity": {"cpu": "4", "memory": "8Gi",
+                                     "pods": "110"}}}
+        assert v.validate_node(n) == []
+
+    def test_bad_taint_and_quantity(self):
+        n = {"metadata": {"name": "n0"},
+             "spec": {"taints": [{"key": "bad key", "effect": "Nuke"}]},
+             "status": {"allocatable": {"cpu": "fast", "pods": "many"}}}
+        errs = v.validate_node(n)
+        assert any("taints[0].key" in e for e in errs)
+        assert any("taints[0].effect" in e for e in errs)
+        assert any("allocatable[cpu]" in e for e in errs)
+        assert any("allocatable[pods]" in e for e in errs)
+
+
+class TestRegistryIntegration:
+    def test_invalid_objects_rejected_422(self):
+        api = APIServer()
+        try:
+            client = Client.local(api)
+            with pytest.raises(errors.StatusError) as ei:
+                client.pods.create(pod(restartPolicy="Sometimes"))
+            assert ei.value.code == 422
+            with pytest.raises(errors.StatusError) as ei:
+                client.nodes.create({
+                    "apiVersion": "v1", "kind": "Node",
+                    "metadata": {"name": "UPPER"}, "status": {}})
+            assert ei.value.code == 422
+            # valid objects still land
+            client.pods.create(pod())
+            assert client.pods.get("p")["metadata"]["name"] == "p"
+        finally:
+            api.close()
