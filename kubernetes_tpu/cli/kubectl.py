@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional
 
 import yaml
 
+from kubernetes_tpu.api.types import parse_cpu_milli, parse_mem_kib
 from kubernetes_tpu.client.rest import Client
 from kubernetes_tpu.machinery import errors, meta
 
@@ -400,6 +401,47 @@ class Kubectl:
         self.out.write(text)
         return 0
 
+    def top(self, kind: str, namespace: str = "default") -> int:
+        """kubectl top pods|nodes (staging/src/k8s.io/kubectl top_*.go):
+        reads the aggregated resource-metrics API the metrics-server
+        publishes (component/metrics_server.py)."""
+        if kind not in ("pods", "nodes", "pod", "node", "po", "no"):
+            self.err.write(f"error: unknown resource {kind!r}\n")
+            return 1
+        nodes = kind.startswith("no")
+        try:
+            rc = self.client.resource("metrics.k8s.io", "v1beta1",
+                                      "nodes" if nodes else "pods",
+                                      not nodes)
+            items = rc.list("" if nodes else namespace).get("items", [])
+        except errors.StatusError as e:
+            if errors.is_not_found(e):
+                # the group genuinely isn't served (no metrics-server);
+                # RBAC denials / server errors surface as themselves
+                self.err.write("error: Metrics API not available\n")
+                return 1
+            raise
+        rows = [("NAME", "CPU(cores)", "MEMORY(bytes)")]
+        for m in items:
+            if nodes:
+                usage = m.get("usage", {})
+            else:
+                cpu = sum(parse_cpu_milli(
+                    (c.get("usage") or {}).get("cpu", 0))
+                    for c in m.get("containers", []))
+                memk = sum(parse_mem_kib(
+                    (c.get("usage") or {}).get("memory", 0))
+                    for c in m.get("containers", []))
+                usage = {"cpu": f"{cpu}m", "memory": f"{memk}Ki"}
+            rows.append((meta.name(m), str(usage.get("cpu", "0")),
+                         str(usage.get("memory", "0"))))
+        widths = [max(len(r[i]) for r in rows) + 3 for i in range(3)]
+        for r in rows:
+            self.out.write("".join(c.ljust(w)
+                                   for c, w in zip(r, widths)).rstrip()
+                           + "\n")
+        return 0
+
     def api_resources(self) -> int:
         self.out.write("NAME  SHORTNAMES  APIGROUP  NAMESPACED  KIND\n")
         for group, _, r in self._discovered_resources():
@@ -441,6 +483,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     ex = sub.add_parser("explain")
     ex.add_argument("path", help="resource[.field.field...]")
+
+    tp = sub.add_parser("top")
+    tp.add_argument("kind", help="pods|nodes")
 
     de = sub.add_parser("delete")
     de.add_argument("resource")
@@ -488,6 +533,8 @@ def main(argv: Optional[List[str]] = None, client: Optional[Client] = None,
             return k.diff(args.filename, args.namespace)
         if args.verb == "explain":
             return k.explain(args.path)
+        if args.verb == "top":
+            return k.top(args.kind, args.namespace)
         if args.verb == "delete":
             return k.delete(args.resource, args.name, args.namespace)
         if args.verb == "scale":

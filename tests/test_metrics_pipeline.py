@@ -92,6 +92,55 @@ class TestMetricsAPI:
         assert ei.value.code == 404
 
 
+class TestKubectlTop:
+    def test_top_pods_and_nodes(self, cluster):
+        """kubectl top reads the aggregated metrics API end to end."""
+        import io
+
+        from kubernetes_tpu.apiserver import HTTPGateway
+        from kubernetes_tpu.cli.kubectl import main as kubectl_main
+
+        client, hollow, ms = cluster
+        for k in hollow.kubelets:
+            k.cri.usage_policy = lambda image: (250, 128 << 20)
+        client.deployments.create(_deployment(2))
+        assert wait_for(lambda: len([
+            p for p in client.pods.list("default")["items"]
+            if p.get("status", {}).get("phase") == "Running"]) == 2,
+            timeout=60)
+        gw = HTTPGateway(client.transport.api).start()
+        try:
+            ms.scrape_once()
+            out = io.StringIO()
+            assert kubectl_main(["-s", gw.url, "top", "pods"],
+                                out=out) == 0
+            text = out.getvalue()
+            assert "CPU(cores)" in text and "250m" in text
+            out = io.StringIO()
+            assert kubectl_main(["-s", gw.url, "top", "nodes"],
+                                out=out) == 0
+            assert "hollow-node-0" in out.getvalue()
+        finally:
+            gw.stop()
+
+    def test_top_without_metrics_server(self):
+        import io
+
+        from kubernetes_tpu.apiserver import APIServer, HTTPGateway
+        from kubernetes_tpu.cli.kubectl import main as kubectl_main
+
+        api = APIServer()
+        gw = HTTPGateway(api).start()
+        try:
+            err = io.StringIO()
+            assert kubectl_main(["-s", gw.url, "top", "pods"],
+                                out=io.StringIO(), err=err) == 1
+            assert "Metrics API not available" in err.getvalue()
+        finally:
+            gw.stop()
+            api.close()
+
+
 class TestHPAOverMetricsAPI:
     def test_hpa_scales_up_from_cri_usage(self, cluster):
         """No annotations anywhere: utilization comes from real (fake-CRI)
