@@ -402,6 +402,9 @@ class Node:
     # per-driver attachable-volume limits (CSINode allocatable / cloud caps,
     # csi_volume_predicate.go getMaxVolumeFunc); absent driver = unlimited
     volume_limits: Dict[str, int] = field(default_factory=dict)
+    # scheduler.alpha.kubernetes.io/preferAvoidPods annotation present
+    # (NodePreferAvoidPods score, priorities/node_prefer_avoid_pods.go)
+    prefer_avoid_pods: bool = False
 
 
 WELL_KNOWN_ZONE_LABEL = "topology.kubernetes.io/zone"

@@ -309,6 +309,9 @@ def node_from_v1(obj: Dict[str, Any]) -> Node:
     return Node(
         name=meta.get("name", ""),
         labels=dict(meta.get("labels") or {}),
+        prefer_avoid_pods=(
+            "scheduler.alpha.kubernetes.io/preferAvoidPods"
+            in (meta.get("annotations") or {})),
         allocatable=Resources(
             milli_cpu=parse_cpu_milli(alloc.get("cpu", 0)),
             memory_kib=parse_mem_kib(alloc.get("memory", 0)),
@@ -442,7 +445,10 @@ def _affinity_to_v1(aff: Affinity) -> Dict[str, Any]:
 
 def node_to_v1(node: Node) -> Dict[str, Any]:
     return {
-        "metadata": {"name": node.name, "labels": dict(node.labels)},
+        "metadata": {"name": node.name, "labels": dict(node.labels),
+                     **({"annotations": {
+                         "scheduler.alpha.kubernetes.io/preferAvoidPods":
+                         "{}"}} if node.prefer_avoid_pods else {})},
         "spec": {
             **({"taints": [
                 {"key": t.key, "value": t.value, "effect": _EFFECT_NAME[t.effect]}

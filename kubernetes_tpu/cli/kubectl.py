@@ -80,19 +80,23 @@ def _node_status(o: Obj) -> str:
     return status
 
 
+def render_rows(header: List[str], rows: List[List[str]],
+                out=sys.stdout) -> None:
+    """Column-aligned table text (the HumanReadablePrinter's layout)."""
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              if rows else len(header[i]) for i in range(len(header))]
+    for r in [header] + rows:
+        out.write("  ".join(v.ljust(w)
+                            for v, w in zip(r, widths)).rstrip() + "\n")
+
+
 def print_table(resource: str, items: List[Obj], namespaced: bool,
                 all_namespaces: bool, out=sys.stdout) -> None:
     cols = list(_COLUMNS.get(resource, _DEFAULT_COLUMNS))
     if all_namespaces and namespaced:
         cols.insert(0, ("NAMESPACE", lambda o: meta.namespace(o)))
-    rows = [[fn(o) for _, fn in cols] for o in items]
-    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
-              for i, (h, _) in enumerate(cols)]
-    out.write("  ".join(h.ljust(w) for (h, _), w in zip(cols, widths)).rstrip()
-              + "\n")
-    for r in rows:
-        out.write("  ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip()
-                  + "\n")
+    render_rows([h for h, _ in cols],
+                [[fn(o) for _, fn in cols] for o in items], out)
 
 
 def print_obj(obj: Obj, fmt: str, out=sys.stdout) -> None:
@@ -421,7 +425,7 @@ class Kubectl:
                 self.err.write("error: Metrics API not available\n")
                 return 1
             raise
-        rows = [("NAME", "CPU(cores)", "MEMORY(bytes)")]
+        rows = []
         for m in items:
             if nodes:
                 usage = m.get("usage", {})
@@ -433,13 +437,9 @@ class Kubectl:
                     (c.get("usage") or {}).get("memory", 0))
                     for c in m.get("containers", []))
                 usage = {"cpu": f"{cpu}m", "memory": f"{memk}Ki"}
-            rows.append((meta.name(m), str(usage.get("cpu", "0")),
-                         str(usage.get("memory", "0"))))
-        widths = [max(len(r[i]) for r in rows) + 3 for i in range(3)]
-        for r in rows:
-            self.out.write("".join(c.ljust(w)
-                                   for c, w in zip(r, widths)).rstrip()
-                           + "\n")
+            rows.append([meta.name(m), str(usage.get("cpu", "0")),
+                         str(usage.get("memory", "0"))])
+        render_rows(["NAME", "CPU(cores)", "MEMORY(bytes)"], rows, self.out)
         return 0
 
     def api_resources(self) -> int:

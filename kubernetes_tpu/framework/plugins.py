@@ -313,14 +313,17 @@ class NodeResourcesMostAllocated(_ResourceScoreBase):
 
 class NodePreferAvoidPods(ScorePlugin):
     """nodepreferavoidpods/ — nodes annotated avoid-pods score 0, others 100
-    (node_prefer_avoid_pods.go). The annotation rides NodeArrays.avoid."""
+    (node_prefer_avoid_pods.go). The annotation rides NodeArrays.avoid
+    (encoded from scheduler.alpha.kubernetes.io/preferAvoidPods).
+    Deviation: the reference applies the avoidance only to pods controlled
+    by an RC/RS (checks the controllerRef kind); here every pod avoids the
+    node — the annotation's operational intent (drain-ish bias) at class
+    granularity."""
 
     def score_matrix(self, state: CycleState, ctx: TensorContext):
-        avoid = getattr(ctx.tables.nodes, "avoid", None)
+        avoid = ctx.tables.nodes.avoid
         N = ctx.tables.nodes.valid.shape[0]
         P = ctx.pending.valid.shape[0]
-        if avoid is None:
-            return jnp.full((P, N), 100.0, jnp.float32)
         return jnp.broadcast_to(
             jnp.where(avoid[None, :], 0.0, 100.0), (P, N)).astype(jnp.float32)
 

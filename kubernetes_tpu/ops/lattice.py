@@ -58,6 +58,15 @@ class EngineConfig(NamedTuple):
     w_interpod: Array       # InterPodAffinity soft score (both directions)
     w_even: Array           # PodTopologySpread ScheduleAnyway score
     w_ssel: Array           # SelectorSpread
+    # wave-admission score window (ops/waves.py): a class admits this wave
+    # only on nodes scoring within `w_window` of its per-class feasible
+    # max. MaxNodeScore=100 (interface.go:87) — one plugin's full swing —
+    # keeps near-tied spreading parallel while a decisively-scored
+    # preference (NodePreferAvoidPods' 0-vs-100 at configured weight,
+    # strong preferred affinity) is honored instead of steamrolled by
+    # same-wave intra-class spreading. The best node always qualifies, so
+    # feasibility is untouched; tied clusters are unaffected.
+    w_window: Array = 100.0
 
 
 def default_engine_config() -> EngineConfig:

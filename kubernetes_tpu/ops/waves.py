@@ -342,6 +342,17 @@ def assign_waves(
 
         mask, score = _class_mask_score(tables, cyc, state)
         mask = mask & nxt_ok[:, None]
+        # score-window admission (EngineConfig.w_window): a class only
+        # admits on nodes within the window of its per-class feasible max
+        # this wave, so decisive score gaps (preferAvoidPods, strong
+        # preferences) aren't steamrolled by same-wave intra-class
+        # spreading. The max itself always qualifies → feasibility (and
+        # the early-fail rule's mask.any) is unchanged; ties are
+        # unaffected. Nodes outside the window become admissible in later
+        # waves once the leading tier fills and the class max drops.
+        best = jnp.max(jnp.where(mask, score, -jnp.inf), axis=1,
+                       keepdims=True)
+        adm_mask = mask & (score >= best - cyc.ecfg.w_window)
         r = _escape_cap(tables, cyc, state, r)
 
         # independent set over the interaction graph, queue-rank order:
@@ -379,9 +390,9 @@ def assign_waves(
         score_rot = jnp.take_along_axis(score, rot, axis=1)
         order_rot = jnp.argsort(-score_rot, axis=1)
         order_n = jnp.take_along_axis(rot, order_rot, axis=1)  # [SC, N]
-        feas_sorted = jnp.take_along_axis(mask, order_n, axis=1)
+        feas_sorted = jnp.take_along_axis(adm_mask, order_n, axis=1)
         allowed = _domain_quota_pass(
-            tables, cyc, state, mask, order_n, feas_sorted)
+            tables, cyc, state, adm_mask, order_n, feas_sorted)
         grank = jnp.cumsum(allowed.astype(jnp.int32), axis=1) - 1
         adm_sorted = allowed & (grank < r[:, None])
         A = jnp.zeros((SC, N), bool).at[

@@ -57,6 +57,8 @@ class NodeArrays(NamedTuple):
     vol_any: Array        # [N, VW] u32 — volumes attached by pods on the node
     vol_rw: Array         # [N, VW] u32 — volumes attached read-write
     vol_limit: Array      # [N, DR] i32 — per-driver attach limits, -1 unlimited
+    avoid: Array          # [N] bool — preferAvoidPods annotation present
+                          # (NodePreferAvoidPods score, node_prefer_avoid_pods.go)
 
 
 class ReqTable(NamedTuple):

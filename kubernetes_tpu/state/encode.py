@@ -699,6 +699,7 @@ class Encoder:
         arrays.vol_limit[i] = -1
         for drv, lim in n.volume_limits.items():
             arrays.vol_limit[i, self.vocabs.vol_drivers.intern(drv)] = lim
+        arrays.avoid[i] = n.prefer_avoid_pods
         used = arrays.used[i]
         used[:] = 0
         arrays.port_pair_any[i] = 0
@@ -755,6 +756,7 @@ class Encoder:
             vol_any=np.zeros((N, d.VW), U32),
             vol_rw=np.zeros((N, d.VW), U32),
             vol_limit=np.full((N, d.DR), -1, I32),
+            avoid=np.zeros((N,), bool),
         )
 
     def build_node_arrays(

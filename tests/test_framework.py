@@ -362,14 +362,17 @@ def test_merge_plugins_disabled_semantics():
 
 
 def test_node_prefer_avoid_pods_shape():
+    import dataclasses
+
     import numpy as np
+
     from kubernetes_tpu.framework.plugins import NodePreferAvoidPods
 
     nodes = [mknode("n0"), mknode("n1")]
+    nodes[1] = dataclasses.replace(nodes[1], prefer_avoid_pods=True)
     tables, ex, pe, d, keys = _encode(nodes, [], [mkpod("a"), mkpod("b"), mkpod("c")])
-    if not hasattr(tables.nodes, "avoid") or getattr(tables.nodes, "avoid", None) is None:
-        import pytest
-        pytest.skip("avoid annotation not encoded in this build")
     ctx = build_context(tables, ex, pe, keys[0], keys[1], d.D)
     out = NodePreferAvoidPods().score_matrix(CycleState(), ctx)
     assert out.shape == (pe.valid.shape[0], tables.nodes.valid.shape[0])
+    got = np.asarray(out)
+    assert (got[:, 0] == 100.0).all() and (got[:, 1] == 0.0).all()

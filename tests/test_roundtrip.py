@@ -163,6 +163,7 @@ def rand_node(rng, i):
         unschedulable=rng.random() < 0.2,
         images_kib={f"reg/{rs(rng)}:v{j}": rng.randint(1, 1 << 20)
                     for j in range(rng.randint(0, 3))},
+        prefer_avoid_pods=rng.random() < 0.2,
     )
 
 
