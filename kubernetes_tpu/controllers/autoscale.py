@@ -80,7 +80,11 @@ class ResourceMetricsProvider:
             lst = self.client.resource(
                 "metrics.k8s.io", "v1beta1", "pods", True).list(ns)
         except errors.StatusError:
-            return None  # API not serving → caller falls back
+            # API not serving → caller falls back; cached negatively so an
+            # HPA sync over many pods does one probe per TTL, not one per pod
+            with self._mu:
+                self._cache[ns] = (now, None)
+            return None
         usage = {}
         for m in lst.get("items", []):
             usage[meta.name(m)] = sum(

@@ -500,8 +500,10 @@ class TestHorizontalPodAutoscaler:
         self._setup(client, replicas=2, max_r=6)
         assert wait_for(lambda: len(client.pods.list("default")["items"]) == 2)
         self._set_utilization(client, 150)  # 3x the 50% target
+        # generous: under full-suite load the controller's resync tick can
+        # lag well past the 10s default
         assert wait_for(lambda: client.deployments.get("web")
-                        ["spec"]["replicas"] == 6)
+                        ["spec"]["replicas"] == 6, timeout=30)
         st = client.horizontalpodautoscalers.get("web").get("status", {})
         assert st.get("desiredReplicas") == 6
 

@@ -104,6 +104,42 @@ def test_singleton_high_class_index_ties_match_scan():
     assert int(np.asarray(res_w.node)[0]) == int(np.asarray(res_s.node)[0])
 
 
+def test_decisive_score_gap_not_steamrolled_by_spreading():
+    """EngineConfig.w_window: a node whose score trails the class max by
+    more than the window must not receive same-wave spillover while the
+    preferred node still has capacity (code-review/verify regression: a
+    10,000-point NodePreferAvoidPods gap used to be ignored because the
+    class admitted one pod per node on its top-r feasible nodes)."""
+    import dataclasses
+
+    from kubernetes_tpu.framework.plugins import NodePreferAvoidPods
+    from kubernetes_tpu.sched.cycle import _schedule_batch, snapshot_with_keys
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.encode import Encoder
+
+    cache = SchedulerCache()
+    enc = Encoder()
+    avoided = dataclasses.replace(
+        Node(name="avoided",
+             allocatable=Resources.make(cpu="8", memory="16Gi", pods=110)),
+        prefer_avoid_pods=True)
+    cache.add_node(avoided)
+    cache.add_node(Node(
+        name="normal",
+        allocatable=Resources.make(cpu="8", memory="16Gi", pods=110)))
+    pods = [Pod(name=f"p{i}",
+                requests=Resources.make(cpu="100m", memory="64Mi"),
+                creation_index=i) for i in range(6)]
+    snap, keys = snapshot_with_keys(cache, enc, pods, None)
+    res = _schedule_batch(snap.tables, snap.pending, keys, snap.dims.D,
+                          snap.existing,
+                          extra_plugins=(NodePreferAvoidPods(),),
+                          extra_weights=(100.0,))
+    node_idx = np.asarray(jax.device_get(res.node))[:6]
+    names = [snap.node_order[i] for i in node_idx]
+    assert names == ["normal"] * 6, names
+
+
 def test_waves_respect_priority_tiers():
     """A higher-priority pod must win the last slot on a nearly-full node
     (activeQ order: priority desc — scheduling_queue.go:119-138)."""
