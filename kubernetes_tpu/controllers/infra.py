@@ -211,6 +211,7 @@ class EndpointSliceController(Controller):
 
 TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
 TAINT_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_MEMORY_PRESSURE = "node.kubernetes.io/memory-pressure"
 
 
 class NodeLifecycleController(Controller):
@@ -273,6 +274,35 @@ class NodeLifecycleController(Controller):
             taints = [t for t in taints if t.get("key") != TAINT_UNREACHABLE]
             self._taint_since.pop(name, None)
             self._write_taints(node, taints, ready="True")
+        self._sync_pressure_taint(node)
+
+    def _sync_pressure_taint(self, node: Dict) -> None:
+        """TaintNodesByCondition: the MemoryPressure condition the kubelet's
+        eviction manager reports becomes the NoSchedule taint
+        `node.kubernetes.io/memory-pressure` — the scheduler's taint filter
+        then repels new pods without any scheduler-side special case."""
+        pressure = any(
+            c.get("type") == "MemoryPressure" and c.get("status") == "True"
+            for c in node.get("status", {}).get("conditions", []))
+        taints = list(node.get("spec", {}).get("taints", []) or [])
+        has = any(t.get("key") == TAINT_MEMORY_PRESSURE for t in taints)
+        if pressure == has:
+            return
+
+        def update():
+            cur = self.client.nodes.get(meta.name(node), "")
+            cur_taints = [t for t in cur.get("spec", {}).get("taints", [])
+                          or [] if t.get("key") != TAINT_MEMORY_PRESSURE]
+            if pressure:
+                cur_taints.append({"key": TAINT_MEMORY_PRESSURE,
+                                   "effect": "NoSchedule"})
+            cur.setdefault("spec", {})["taints"] = cur_taints
+            self.client.nodes.update(cur, "")
+
+        try:
+            update()
+        except errors.StatusError:
+            pass
 
     def _write_taints(self, node: Dict, taints: List[Dict], ready: str) -> None:
         def update():

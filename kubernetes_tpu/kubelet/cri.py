@@ -66,6 +66,10 @@ class FakeCRI:
         # the fake's stand-in for cadvisor-fed usage, overridable per test
         self.usage_policy: Callable[[str], tuple] = \
             lambda image: (100, 64 << 20)
+        # probe hook: (image, kind) → bool; the fake's stand-in for
+        # exec/http/tcp probe outcomes ("readiness" | "liveness")
+        self.probe_policy: Callable[[str, str], bool] = \
+            lambda image, kind: True
 
     # -- RuntimeService ----------------------------------------------------- #
 
@@ -147,6 +151,14 @@ class FakeCRI:
                 if sb.pod_uid == pod_uid and sb.state == SANDBOX_READY:
                     return sb
             return None
+
+    def probe(self, cid: str, kind: str) -> bool:
+        """One probe attempt against a container (the prober's exec/http/tcp
+        check collapsed to the policy hook). Non-running containers fail."""
+        c = self.container_status(cid)
+        if c is None or c.state != CONTAINER_RUNNING:
+            return False
+        return bool(self.probe_policy(c.image, kind))
 
     def list_stats(self) -> List[dict]:
         """ListContainerStats (api.proto RuntimeService): per-running-container

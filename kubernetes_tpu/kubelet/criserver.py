@@ -150,6 +150,8 @@ class CRIServer:
                 return {"images": sorted(rt.images)}
         if method == "ListContainerStats":
             return {"stats": rt.list_stats()}
+        if method == "Probe":  # the prober's check, policy-backed (fake)
+            return {"ok": rt.probe(p["containerId"], p["kind"])}
         if method == "Tick":  # fake-only: PLEG relist clock
             return {"changed": rt.tick()}
         if method == "SetExitRules":  # fake-only: containertest injection
@@ -340,6 +342,9 @@ class RemoteCRI:
 
     def list_stats(self) -> List[Dict[str, Any]]:
         return self._call("ListContainerStats")["stats"]
+
+    def probe(self, cid: str, kind: str) -> bool:
+        return self._call("Probe", containerId=cid, kind=kind)["ok"]
 
     def version(self) -> Dict[str, Any]:
         return self._call("Version")

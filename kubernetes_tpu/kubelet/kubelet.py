@@ -40,6 +40,7 @@ class Kubelet:
                  heartbeat_interval: float = 10.0,
                  housekeeping_interval: float = 0.5,
                  checkpoint_dir: Optional[str] = None,
+                 eviction_hard: Optional[Dict[str, str]] = None,
                  clock=time.time):
         self.client = client
         self.node_name = node_name
@@ -67,6 +68,22 @@ class Kubelet:
         # is already gone from the API (no more informer events), so the
         # housekeeping loop owns the retry
         self._pending_teardowns: Dict[str, Obj] = {}
+        # prober manager (pkg/kubelet/prober): per-(uid, container, kind)
+        # consecutive-count state; readiness gates the Ready condition,
+        # liveness failure restarts the container
+        self._probe_state: Dict[tuple, Dict[str, float]] = {}
+        self._restart_counts: Dict[tuple, int] = {}
+        self._container_started: Dict[str, float] = {}
+        # eviction manager (pkg/kubelet/eviction/eviction_manager.go):
+        # evictionHard thresholds, e.g. {"memory.available": "1Gi"} — when
+        # this node's CRI-reported memory usage leaves less available than
+        # the threshold, MemoryPressure goes True (+ NoSchedule taint) and
+        # pods are evicted lowest-priority-first until below threshold
+        self.eviction_hard = dict(eviction_hard or {})
+        self.under_memory_pressure = False
+        # uids this kubelet evicted: blocks resync-resurrection while the
+        # Failed status propagates through the watch (cleared at teardown)
+        self._evicted: set = set()
 
     # ------------------------------------------------------------------ #
     # node registration + heartbeat (kubelet_node_status.go)
@@ -103,8 +120,18 @@ class Kubelet:
         try:
             node = self.client.nodes.get(self.node_name, "")
             conds = [c for c in node.get("status", {}).get("conditions", [])
-                     if c.get("type") != "Ready"]
+                     if c.get("type") not in ("Ready", "MemoryPressure")]
             conds.append(self._ready_condition())
+            if self.eviction_hard:
+                # the eviction manager's verdict rides the heartbeat
+                # (kubelet_node_status.go setNodeMemoryPressureCondition)
+                conds.append({
+                    "type": "MemoryPressure",
+                    "status": "True" if self.under_memory_pressure
+                    else "False",
+                    "reason": "KubeletHasInsufficientMemory"
+                    if self.under_memory_pressure
+                    else "KubeletHasSufficientMemory"})
             node.setdefault("status", {})["conditions"] = conds
             node["status"]["capacity"] = dict(self.capacity)
             node["status"].setdefault("allocatable", dict(self.capacity))
@@ -181,6 +208,8 @@ class Kubelet:
                     parked = list(self._pending_teardowns.values())
                 for pod in parked:
                     self._pod_deleted(pod)
+                if self.eviction_hard:
+                    self._check_eviction()
             except Exception:  # noqa: BLE001 — node loops never die
                 pass
 
@@ -203,7 +232,7 @@ class Kubelet:
             return
         uid = meta.uid(pod)
         phase = pod.get("status", {}).get("phase", "")
-        if phase in ("Succeeded", "Failed"):
+        if phase in ("Succeeded", "Failed") or uid in self._evicted:
             return
         with self._pod_mu:
             sid = self._sandbox_by_uid.get(uid)
@@ -226,12 +255,162 @@ class Kubelet:
                 cids.append(cid)
                 created = True
                 self.cri.start_container(cid)
+                self._container_started[cid] = self.clock()
             if created and self.checkpoints:
                 self.checkpoints.create_checkpoint(
                     f"pod-{uid}", {"sandbox": sid, "containers": list(cids)})
             if not created:
                 self._restart_failed_containers(pod, uid)
+            self._run_probes(pod, uid, cids)
         self._write_status(pod)
+
+    # ------------------------------------------------------------------ #
+    # eviction manager (pkg/kubelet/eviction/eviction_manager.go)
+    # ------------------------------------------------------------------ #
+
+    def _check_eviction(self) -> None:
+        """synchronize() analog: compare memory.available against the hard
+        threshold; under pressure, evict the lowest-priority / heaviest pod
+        (rankMemoryPressure: priority, then usage) and flag the condition
+        the heartbeat + taint publish. One stats snapshot feeds both the
+        availability sum and the ranking, so the verdict and the victim
+        come from the same observation."""
+        from kubernetes_tpu.api.types import parse_mem_kib
+
+        thresh = self.eviction_hard.get("memory.available")
+        if not thresh:
+            return
+        with self._pod_mu:
+            uids = set(self._sandbox_by_uid)
+        usage: Dict[str, int] = {}
+        for s in self.cri.list_stats():
+            uid = s.get("podUid", "")
+            if uid in uids:
+                usage[uid] = usage.get(uid, 0) + s["memoryBytes"]
+        cap_b = parse_mem_kib(self.capacity.get("memory", "0")) * 1024
+        avail = cap_b - sum(usage.values())
+        pressure = avail < parse_mem_kib(thresh) * 1024
+        self.under_memory_pressure = pressure
+        if not pressure:
+            return
+        victims = []
+        for pod in self._informer.lister.list() if self._informer else []:
+            phase = pod.get("status", {}).get("phase", "")
+            uid = meta.uid(pod)
+            if phase in ("Succeeded", "Failed") or uid in self._evicted:
+                continue
+            if uid not in usage:
+                continue
+            victims.append((int(pod.get("spec", {}).get("priority", 0) or 0),
+                            -usage[uid], meta.namespaced_key(pod), pod))
+        if not victims:
+            return
+        # key excludes the pod dict: rank ties must not fall through to
+        # (unorderable) dict comparison
+        victims.sort(key=lambda v: v[:3])
+        self._evict_pod(victims[0][3])
+
+    def _evict_pod(self, pod: Obj) -> None:
+        """Kill the pod's containers and report Failed/Evicted — the
+        reference's evictPod (the object survives in Failed state; a
+        controller replaces it elsewhere). The uid is marked evicted so a
+        stale lister copy (watch lag) cannot resurrect the sandbox before
+        the Failed status round-trips."""
+        uid = meta.uid(pod)
+        with self._pod_mu:
+            self._evicted.add(uid)
+            sid = self._sandbox_by_uid.pop(uid, None)
+            cids = self._containers_by_uid.pop(uid, [])
+            for cid in cids:
+                self._container_started.pop(cid, None)
+            for d in (self._probe_state, self._restart_counts):
+                for k in [k for k in d if k[0] == uid]:
+                    del d[k]
+        if sid is not None:
+            try:
+                self.cri.stop_pod_sandbox(sid)
+                self.cri.remove_pod_sandbox(sid)
+            except CRIError:
+                pass
+        for _ in range(5):  # CAS-retry: informer status writes race this
+            try:
+                cur = self.client.pods.get(meta.name(pod),
+                                           meta.namespace(pod))
+                cur["status"] = {**cur.get("status", {}),
+                                 "phase": "Failed", "reason": "Evicted",
+                                 "message": "The node was low on resource: "
+                                            "memory."}
+                self.client.pods.update_status(cur, meta.namespace(pod))
+                return
+            except errors.StatusError as e:
+                if not errors.is_conflict(e):
+                    return
+
+    # ------------------------------------------------------------------ #
+    # prober manager (pkg/kubelet/prober/prober_manager.go): readiness
+    # results gate the Ready condition; liveness failure past the
+    # threshold restarts the container (worker.go doProbe)
+    # ------------------------------------------------------------------ #
+
+    def _run_probes(self, pod: Obj, uid: str, cids: List[str]) -> None:
+        spec_containers = pod.get("spec", {}).get("containers", []) or []
+        now = self.clock()
+        for c, cid in zip(spec_containers, cids):
+            status = self.cri.container_status(cid)
+            if status is None or status.state != CONTAINER_RUNNING:
+                # the reference stops probe workers for terminated
+                # containers — restartPolicy, not liveness, owns their fate
+                continue
+            for kind in ("readiness", "liveness"):
+                probe = c.get(f"{kind}Probe")
+                if not probe:
+                    continue
+                key = (uid, c.get("name", "c"), kind)
+                st = self._probe_state.setdefault(
+                    key, {"ok": False, "fails": 0, "passes": 0, "last": 0.0})
+                delay = float(probe.get("initialDelaySeconds", 0) or 0)
+                period = float(probe.get("periodSeconds", 10) or 10)
+                started = self._container_started.get(cid, now)
+                if now - started < delay or now - st["last"] < period:
+                    continue
+                st["last"] = now
+                ok = self.cri.probe(cid, kind)
+                if ok:
+                    st["passes"] += 1
+                    st["fails"] = 0
+                    if st["passes"] >= int(probe.get("successThreshold", 1)
+                                           or 1):
+                        st["ok"] = True
+                else:
+                    st["fails"] += 1
+                    st["passes"] = 0
+                    if st["fails"] >= int(probe.get("failureThreshold", 3)
+                                          or 3):
+                        st["ok"] = False
+                        if kind == "liveness":
+                            # the kubelet kills and restarts an unhealthy
+                            # container (kuberuntime_manager computePodActions)
+                            self.cri.stop_container(cid, 137)
+                            self.cri.start_container(cid)
+                            rkey = (uid, c.get("name", "c"))
+                            self._restart_counts[rkey] = \
+                                self._restart_counts.get(rkey, 0) + 1
+                            self._container_started[cid] = now
+                            st.update(fails=0, passes=0)
+                            # a restarted container is NOT ready until its
+                            # readiness probe passes again
+                            self._probe_state.pop(
+                                (uid, c.get("name", "c"), "readiness"),
+                                None)
+
+    def _ready_gate(self, uid: str, name: str, pod: Obj) -> bool:
+        """Readiness verdict for one container: True unless a readinessProbe
+        is defined and has not (yet) passed."""
+        for c in pod.get("spec", {}).get("containers", []) or []:
+            if c.get("name", "c") == name and c.get("readinessProbe"):
+                return bool(self._probe_state.get(
+                    (uid, name, "readiness"), {}).get("ok", False))
+        return True
 
     def _restart_failed_containers(self, pod: Obj, uid: str) -> None:
         """Container restarts per restartPolicy (SyncPod's computePodActions):
@@ -271,9 +450,15 @@ class Kubelet:
                     self._pending_teardowns[uid] = pod
                 raise
         with self._pod_mu:
+            for cid in self._containers_by_uid.get(uid, []):
+                self._container_started.pop(cid, None)
             self._sandbox_by_uid.pop(uid, None)
             self._containers_by_uid.pop(uid, None)
             self._pending_teardowns.pop(uid, None)
+            self._evicted.discard(uid)
+            for d in (self._probe_state, self._restart_counts):
+                for k in [k for k in d if k[0] == uid]:
+                    del d[k]
         with self._status_mu:
             self._last_status.pop(meta.namespaced_key(pod), None)
         if self.checkpoints:
@@ -327,11 +512,15 @@ class Kubelet:
             c = self.cri.container_status(cid)
             if c is None:
                 continue
+            restarts = self._restart_counts.get((uid, c.name), 0)
             if c.state == CONTAINER_RUNNING:
                 n_running += 1
-                statuses.append({"name": c.name, "ready": True,
-                                 "state": {"running": {}},
-                                 "restartCount": 0, "image": c.image})
+                statuses.append({
+                    "name": c.name,
+                    # readiness probes gate Ready (prober results manager)
+                    "ready": self._ready_gate(uid, c.name, pod),
+                    "state": {"running": {}},
+                    "restartCount": restarts, "image": c.image})
             elif c.state == CONTAINER_EXITED:
                 if c.exit_code == 0:
                     n_succeeded += 1
@@ -340,7 +529,7 @@ class Kubelet:
                 statuses.append({"name": c.name, "ready": False,
                                  "state": {"terminated":
                                            {"exitCode": c.exit_code}},
-                                 "restartCount": 0, "image": c.image})
+                                 "restartCount": restarts, "image": c.image})
         # PodPhase rules (pkg/kubelet/kubelet_pods.go getPhase): all
         # succeeded → Succeeded; any failed with restartPolicy Never →
         # Failed; otherwise Running while anything runs or will restart
@@ -356,7 +545,10 @@ class Kubelet:
             phase = "Running"
         else:
             phase = "Pending"
-        ready = phase == "Running" and n_running == total
+        # pod Ready requires every container running AND readiness-passing
+        # (status_manager GeneratePodReadyCondition)
+        ready = (phase == "Running" and n_running == total
+                 and all(s.get("ready", False) for s in statuses))
         return {
             "phase": phase,
             "podIP": sb.ip if sb else "",

@@ -170,6 +170,150 @@ class TestEndToEnd:
             for e in client.events.list("default")["items"]), timeout=20)
 
 
+class TestProbes:
+    """pkg/kubelet/prober: readiness gates Ready (and through it the
+    endpoint controllers); liveness failure restarts the container."""
+
+    def test_readiness_gates_ready_and_endpoints(self, cluster):
+        client, hollow, sched, cm = cluster
+        for k in hollow.kubelets:  # readiness red for the probed image
+            k.cri.probe_policy = \
+                lambda image, kind: not ("gate" in image
+                                         and kind == "readiness")
+        client.services.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "gated", "namespace": "default"},
+            "spec": {"selector": {"app": "gated"},
+                     "ports": [{"port": 80}]}})
+        client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "g", "namespace": "default",
+                         "labels": {"app": "gated"}},
+            "spec": {"containers": [{
+                "name": "c", "image": "gate:v1",
+                "readinessProbe": {"periodSeconds": 1,
+                                   "failureThreshold": 1}}]}})
+        assert wait_for(lambda: client.pods.get("g")
+                        .get("status", {}).get("phase") == "Running",
+                        timeout=60)
+
+        def ready_cond(p):
+            return any(c["type"] == "Ready" and c["status"] == "True"
+                       for c in p.get("status", {}).get("conditions", []))
+
+        # Running but NOT Ready; endpoints see it as notReady
+        assert wait_for(lambda: not ready_cond(client.pods.get("g"))
+                        and client.pods.get("g")["status"]
+                        .get("containerStatuses", [{}])[0]
+                        .get("ready") is False, timeout=30)
+        assert wait_for(lambda: (client.endpoints.get("gated")
+                                 .get("subsets") or [{}])[0]
+                        .get("notReadyAddresses"), timeout=30)
+
+        # probe turns green → Ready flips, endpoints promote the address
+        for k in hollow.kubelets:
+            k.cri.probe_policy = lambda image, kind: True
+        assert wait_for(lambda: ready_cond(client.pods.get("g")),
+                        timeout=30)
+        assert wait_for(lambda: (client.endpoints.get("gated")
+                                 .get("subsets") or [{}])[0]
+                        .get("addresses"), timeout=30)
+
+    def test_liveness_failure_restarts_container(self, cluster):
+        client, hollow, sched, cm = cluster
+        for k in hollow.kubelets:
+            k.cri.probe_policy = \
+                lambda image, kind: not ("sick" in image
+                                         and kind == "liveness")
+        client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "s", "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "c", "image": "sick:v1",
+                "livenessProbe": {"periodSeconds": 1,
+                                  "failureThreshold": 2}}]}})
+        assert wait_for(lambda: client.pods.get("s")
+                        .get("status", {}).get("phase") == "Running",
+                        timeout=60)
+        assert wait_for(lambda: client.pods.get("s")["status"]
+                        .get("containerStatuses", [{}])[0]
+                        .get("restartCount", 0) >= 1, timeout=60), \
+            "liveness failure must restart the container"
+        # the restarted container keeps running (pod survives)
+        assert client.pods.get("s")["status"]["phase"] == "Running"
+
+
+class TestEviction:
+    """pkg/kubelet/eviction: memory pressure evicts the lowest-priority pod,
+    reports the MemoryPressure condition, and (via nodelifecycle's
+    TaintNodesByCondition) taints the node NoSchedule."""
+
+    def test_memory_pressure_evicts_and_taints(self):
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.client import Client
+        from kubernetes_tpu.controllers import ControllerManager
+        from kubernetes_tpu.kubelet import FakeCRI, Kubelet
+
+        api = APIServer()
+        client = Client.local(api)
+        cri = FakeCRI()
+        # housekeeping is deliberately SLOW relative to heartbeat/lifecycle
+        # polls: pressure is detected at one tick and re-evaluated at the
+        # next, leaving a ~2s window in which the MemoryPressure condition
+        # and taint are observable before the eviction clears them
+        kubelet = Kubelet(client, "squeezed",
+                          capacity={"cpu": "8", "memory": "8Gi",
+                                    "pods": "110"},
+                          cri=cri, heartbeat_interval=0.3,
+                          housekeeping_interval=2.0,
+                          eviction_hard={"memory.available": "2Gi"})
+        sched = SchedulerServer(client).start()
+        cm = ControllerManager(client, controllers=["nodelifecycle"],
+                               poll_interval=0.3).start()
+        try:
+            kubelet.start()
+            # containers "use" 3.5GiB each: two pods → 1GiB available < 2GiB
+            cri.usage_policy = lambda image: (100, int(3.5 * (1 << 30)))
+            for name, prio in (("keep", 100), ("sacrifice", 0)):
+                client.pods.create({
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": name, "namespace": "default"},
+                    "spec": {"priority": prio,
+                             "containers": [{"name": "c", "image": "i"}]}})
+            assert wait_for(lambda: all(
+                client.pods.get(n).get("status", {}).get("phase")
+                == "Running" for n in ("keep", "sacrifice")), timeout=60)
+
+            # the low-priority pod is evicted; the high-priority one stays
+            assert wait_for(lambda: client.pods.get("sacrifice")
+                            .get("status", {}).get("phase") == "Failed",
+                            timeout=30)
+            assert client.pods.get("sacrifice")["status"]["reason"] == \
+                "Evicted"
+            assert client.pods.get("keep")["status"]["phase"] == "Running"
+
+            # while pressure holds, the condition rides the heartbeat and
+            # nodelifecycle converts it into the NoSchedule taint
+            assert wait_for(lambda: any(
+                t.get("key") == "node.kubernetes.io/memory-pressure"
+                for t in client.nodes.get("squeezed", "")
+                .get("spec", {}).get("taints", []) or []), timeout=10), \
+                "pressure taint never surfaced"
+
+            # the eviction brought usage down: pressure clears, taint lifts
+            assert wait_for(lambda: not kubelet.under_memory_pressure,
+                            timeout=30)
+            assert wait_for(lambda: not any(
+                t.get("key") == "node.kubernetes.io/memory-pressure"
+                for t in client.nodes.get("squeezed", "")
+                .get("spec", {}).get("taints", []) or []), timeout=30)
+        finally:
+            cm.stop()
+            sched.stop()
+            kubelet.stop()
+            api.close()
+
+
 class TestKubeletCheckpoint:
     def test_checkpoint_roundtrip_and_corruption(self, tmp_path):
         from kubernetes_tpu.kubelet import (
