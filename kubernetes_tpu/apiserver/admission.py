@@ -394,6 +394,8 @@ class EvictionPDBGate(AdmissionPlugin):
 def default_plugins() -> List[AdmissionPlugin]:
     """The default-enabled chain, in the reference's ordering
     (options/plugins.go AllOrderedPlugins, reduced to our surface)."""
+    from kubernetes_tpu.apiserver.service_alloc import ServiceAllocatorPlugin
+
     return [
         NamespaceLifecycle(),
         LimitRanger(),
@@ -402,4 +404,7 @@ def default_plugins() -> List[AdmissionPlugin]:
         PriorityAdmission(),
         EvictionPDBGate(),
         ResourceQuotaAdmission(),
+        # ClusterIP/NodePort allocation (registry/core/service seat —
+        # docs/PARITY.md #17): last, so it sees the defaulted object
+        ServiceAllocatorPlugin(),
     ]

@@ -84,6 +84,7 @@ class Kubelet:
         # uids this kubelet evicted: blocks resync-resurrection while the
         # Failed status propagates through the watch (cleared at teardown)
         self._evicted: set = set()
+        self._pending_evict_writes: Dict[str, Obj] = {}
 
     # ------------------------------------------------------------------ #
     # node registration + heartbeat (kubelet_node_status.go)
@@ -206,8 +207,13 @@ class Kubelet:
                     self._pod_changed(pod)
                 with self._pod_mu:
                     parked = list(self._pending_teardowns.values())
+                    evict_writes = list(self._pending_evict_writes.items())
                 for pod in parked:
                     self._pod_deleted(pod)
+                for uid, pod in evict_writes:
+                    if self._write_evicted_status(pod):
+                        with self._pod_mu:
+                            self._pending_evict_writes.pop(uid, None)
                 if self.eviction_hard:
                     self._check_eviction()
             except Exception:  # noqa: BLE001 — node loops never die
@@ -235,6 +241,11 @@ class Kubelet:
         if phase in ("Succeeded", "Failed") or uid in self._evicted:
             return
         with self._pod_mu:
+            if uid in self._evicted:
+                # re-checked UNDER the lock: a sync that passed the outer
+                # guard while _evict_pod held the lock must not recreate
+                # the sandbox it just destroyed
+                return
             sid = self._sandbox_by_uid.get(uid)
             if sid is None:
                 sid = self.cri.run_pod_sandbox(meta.name(pod),
@@ -332,6 +343,14 @@ class Kubelet:
                 self.cri.remove_pod_sandbox(sid)
             except CRIError:
                 pass
+        if not self._write_evicted_status(pod):
+            # parked: the housekeeping loop re-drives the write until it
+            # lands — the sandbox is already gone, so the pod must not be
+            # left reporting Running forever
+            with self._pod_mu:
+                self._pending_evict_writes[meta.uid(pod)] = pod
+
+    def _write_evicted_status(self, pod: Obj) -> bool:
         for _ in range(5):  # CAS-retry: informer status writes race this
             try:
                 cur = self.client.pods.get(meta.name(pod),
@@ -341,10 +360,11 @@ class Kubelet:
                                  "message": "The node was low on resource: "
                                             "memory."}
                 self.client.pods.update_status(cur, meta.namespace(pod))
-                return
+                return True
             except errors.StatusError as e:
                 if not errors.is_conflict(e):
-                    return
+                    return True  # gone from the API — nothing left to mark
+        return False
 
     # ------------------------------------------------------------------ #
     # prober manager (pkg/kubelet/prober/prober_manager.go): readiness
@@ -388,20 +408,18 @@ class Kubelet:
                                           or 3):
                         st["ok"] = False
                         if kind == "liveness":
-                            # the kubelet kills and restarts an unhealthy
-                            # container (kuberuntime_manager computePodActions)
+                            # the kubelet KILLS on liveness failure; whether
+                            # it restarts is restartPolicy's call
+                            # (kuberuntime_manager computePodActions:
+                            # Never → the container stays terminated and
+                            # the pod settles via getPhase)
                             self.cri.stop_container(cid, 137)
-                            self.cri.start_container(cid)
-                            rkey = (uid, c.get("name", "c"))
-                            self._restart_counts[rkey] = \
-                                self._restart_counts.get(rkey, 0) + 1
-                            self._container_started[cid] = now
                             st.update(fails=0, passes=0)
-                            # a restarted container is NOT ready until its
-                            # readiness probe passes again
-                            self._probe_state.pop(
-                                (uid, c.get("name", "c"), "readiness"),
-                                None)
+                            policy = pod.get("spec", {}).get(
+                                "restartPolicy", "Always")
+                            if policy != "Never":
+                                self._restart_container(uid, c.get(
+                                    "name", "c"), cid, now)
 
     def _ready_gate(self, uid: str, name: str, pod: Obj) -> bool:
         """Readiness verdict for one container: True unless a readinessProbe
@@ -411,6 +429,19 @@ class Kubelet:
                 return bool(self._probe_state.get(
                     (uid, name, "readiness"), {}).get("ok", False))
         return True
+
+    def _restart_container(self, uid: str, name: str, cid: str,
+                           now: float) -> None:
+        """The single restart chokepoint: starts the container and does the
+        bookkeeping EVERY restart needs — count it, restamp the start time
+        (initialDelaySeconds measures from here), and drop the readiness
+        verdict (a restarted container is not ready until its probe passes
+        again)."""
+        self.cri.start_container(cid)
+        rkey = (uid, name)
+        self._restart_counts[rkey] = self._restart_counts.get(rkey, 0) + 1
+        self._container_started[cid] = now
+        self._probe_state.pop((uid, name, "readiness"), None)
 
     def _restart_failed_containers(self, pod: Obj, uid: str) -> None:
         """Container restarts per restartPolicy (SyncPod's computePodActions):
@@ -422,11 +453,12 @@ class Kubelet:
                 continue
             if c.state == CONTAINER_CREATED:
                 # created but never started (a partial sync lost the start):
-                # repaired regardless of restartPolicy — this is first start
+                # repaired regardless of restartPolicy — this is first
+                # start, not a restart, so no bookkeeping
                 self.cri.start_container(cid)
             elif c.state == CONTAINER_EXITED and policy != "Never" and (
                     policy == "Always" or c.exit_code != 0):
-                self.cri.start_container(cid)
+                self._restart_container(uid, c.name, cid, self.clock())
 
     def _pod_deleted(self, pod: Obj) -> None:
         try:
@@ -455,6 +487,7 @@ class Kubelet:
             self._sandbox_by_uid.pop(uid, None)
             self._containers_by_uid.pop(uid, None)
             self._pending_teardowns.pop(uid, None)
+            self._pending_evict_writes.pop(uid, None)
             self._evicted.discard(uid)
             for d in (self._probe_state, self._restart_counts):
                 for k in [k for k in d if k[0] == uid]:

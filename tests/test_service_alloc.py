@@ -1,0 +1,123 @@
+"""Service ClusterIP / NodePort allocation (apiserver/service_alloc.py ⇔
+pkg/registry/core/service ipallocator + portallocator + repair)."""
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.machinery import errors
+
+
+@pytest.fixture
+def api():
+    a = APIServer()
+    yield a
+    a.close()
+
+
+@pytest.fixture
+def client(api):
+    return Client.local(api)
+
+
+def svc(name, **spec):
+    return {"apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"selector": {"app": name},
+                     "ports": [{"port": 80}], **spec}}
+
+
+class TestClusterIPAllocation:
+    def test_auto_allocation_unique_in_cidr(self, client):
+        import ipaddress
+
+        ips = set()
+        for i in range(5):
+            out = client.services.create(svc(f"s{i}"))
+            ip = out["spec"]["clusterIP"]
+            assert ipaddress.ip_address(ip) in \
+                ipaddress.ip_network("10.96.0.0/16")
+            ips.add(ip)
+        assert len(ips) == 5
+
+    def test_headless_stays_none(self, client):
+        out = client.services.create(svc("headless", clusterIP="None"))
+        assert out["spec"]["clusterIP"] == "None"
+
+    def test_specific_ip_reserved_and_conflicts(self, client):
+        client.services.create(svc("a", clusterIP="10.96.7.7"))
+        with pytest.raises(errors.StatusError) as ei:
+            client.services.create(svc("b", clusterIP="10.96.7.7"))
+        assert ei.value.code == 422
+        assert "already allocated" in ei.value.message
+        # outside the CIDR → invalid
+        with pytest.raises(errors.StatusError) as ei:
+            client.services.create(svc("c", clusterIP="192.168.1.1"))
+        assert ei.value.code == 422
+
+    def test_delete_releases(self, client):
+        client.services.create(svc("tmp", clusterIP="10.96.9.9"))
+        client.services.delete("tmp", "default")
+        out = client.services.create(svc("tmp2", clusterIP="10.96.9.9"))
+        assert out["spec"]["clusterIP"] == "10.96.9.9"
+
+    def test_cluster_ip_immutable_on_update(self, client):
+        client.services.create(svc("imm"))
+        cur = client.services.get("imm")
+        cur["spec"]["clusterIP"] = "10.96.11.11"
+        with pytest.raises(errors.StatusError) as ei:
+            client.services.update(cur, "default")
+        assert ei.value.code == 422
+        assert "immutable" in ei.value.message
+        # unchanged IP round-trips fine
+        cur = client.services.get("imm")
+        cur["metadata"].setdefault("labels", {})["x"] = "y"
+        client.services.update(cur, "default")
+
+    def test_repair_seeds_from_storage_on_restart(self, api, client):
+        created = client.services.create(svc("durable"))
+        ip = created["spec"]["clusterIP"]
+        api2 = APIServer(storage=api.storage)
+        c2 = Client.local(api2)
+        with pytest.raises(errors.StatusError):
+            c2.services.create(svc("clash", clusterIP=ip))
+        fresh = c2.services.create(svc("fresh"))
+        assert fresh["spec"]["clusterIP"] != ip
+
+
+class TestNodePortAllocation:
+    def test_auto_allocation_in_range(self, client):
+        out = client.services.create(svc(
+            "np", type="NodePort",
+            ports=[{"port": 80}, {"port": 443}]))
+        ports = [p["nodePort"] for p in out["spec"]["ports"]]
+        assert all(30000 <= p <= 32767 for p in ports)
+        assert len(set(ports)) == 2
+
+    def test_specific_port_and_conflict(self, client):
+        client.services.create(svc("np1", type="NodePort",
+                                   ports=[{"port": 80,
+                                           "nodePort": 30777}]))
+        with pytest.raises(errors.StatusError) as ei:
+            client.services.create(svc("np2", type="NodePort",
+                                       ports=[{"port": 80,
+                                               "nodePort": 30777}]))
+        assert ei.value.code == 422
+        with pytest.raises(errors.StatusError) as ei:
+            client.services.create(svc("np3", type="NodePort",
+                                       ports=[{"port": 80,
+                                               "nodePort": 99}]))
+        assert "not in the valid range" in ei.value.message
+
+    def test_cluster_ip_type_gets_no_node_ports(self, client):
+        out = client.services.create(svc("plain"))
+        assert "nodePort" not in out["spec"]["ports"][0]
+
+    def test_update_keeps_existing_allocates_new(self, client):
+        out = client.services.create(svc("grow", type="NodePort"))
+        first = out["spec"]["ports"][0]["nodePort"]
+        cur = client.services.get("grow")
+        cur["spec"]["ports"].append({"port": 443})
+        updated = client.services.update(cur, "default")
+        ports = [p.get("nodePort") for p in updated["spec"]["ports"]]
+        assert ports[0] == first and ports[1] and ports[1] != first
