@@ -131,6 +131,22 @@ def _requirements(exprs: Optional[List[Dict[str, Any]]]) -> Tuple[Requirement, .
     return tuple(out)
 
 
+def node_names_from_terms(terms) -> Optional[List[str]]:
+    """metadata.name `In` values across raw v1 nodeSelectorTerms — the
+    matchFields extraction shared by the PV topology walk
+    (volume/pv_controller.py) and the daemon-pod target resolution
+    (controllers/workloads.py). None when no such field exists (an
+    unrestricted term list is not an empty restriction)."""
+    names: List[str] = []
+    restricted = False
+    for t in terms or []:
+        for f in t.get("matchFields") or []:
+            if f.get("key") == "metadata.name" and f.get("operator") == "In":
+                restricted = True
+                names.extend(f.get("values") or [])
+    return names if restricted else None
+
+
 def _node_term(term: Dict[str, Any]) -> NodeSelectorTerm:
     fields = term.get("matchFields") or []
     names: Tuple[str, ...] = ()

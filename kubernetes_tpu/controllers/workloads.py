@@ -334,8 +334,44 @@ class StatefulSetController(Controller):
                 pass
 
 
+DAEMON_TOLERATIONS = [
+    # util/daemonset_util.go AddOrUpdateDaemonPodTolerations: daemons ride
+    # out node conditions ordinary pods are evicted/repelled by
+    {"key": "node.kubernetes.io/not-ready",
+     "operator": "Exists", "effect": "NoExecute"},
+    {"key": "node.kubernetes.io/unreachable",
+     "operator": "Exists", "effect": "NoExecute"},
+    {"key": "node.kubernetes.io/memory-pressure",
+     "operator": "Exists", "effect": "NoSchedule"},
+    {"key": "node.kubernetes.io/disk-pressure",
+     "operator": "Exists", "effect": "NoSchedule"},
+    {"key": "node.kubernetes.io/unschedulable",
+     "operator": "Exists", "effect": "NoSchedule"},
+]
+
+
+def _daemon_pod_target(p: Dict) -> str:
+    """The node a daemon pod is FOR: spec.nodeName once bound, else the
+    metadata.name node-affinity target it was created with — a pending
+    daemon pod must count against its node or the controller would spawn
+    duplicates every sync while the scheduler works."""
+    nn = p.get("spec", {}).get("nodeName", "")
+    if nn:
+        return nn
+    from kubernetes_tpu.api.v1 import node_names_from_terms
+
+    names = node_names_from_terms(
+        ((p.get("spec", {}).get("affinity") or {})
+         .get("nodeAffinity") or {}).get(
+            "requiredDuringSchedulingIgnoredDuringExecution", {}).get(
+            "nodeSelectorTerms", []))
+    return names[0] if names else ""
+
+
 class DaemonSetController(Controller):
-    """daemon/daemon_controller.go: one pod per eligible node."""
+    """daemon/daemon_controller.go: one pod per eligible node, each bound by
+    the default scheduler through metadata.name node affinity
+    (ScheduleDaemonSetPods)."""
 
     name = "daemonset"
 
@@ -356,17 +392,23 @@ class DaemonSetController(Controller):
 
     def _node_eligible(self, ds: Dict, node: Dict) -> bool:
         """Simulate the scheduling gates the reference checks
-        (nodeShouldRunDaemonPod): unschedulable, nodeSelector, NoSchedule
-        taints not tolerated."""
-        if node.get("spec", {}).get("unschedulable"):
-            return False
+        (nodeShouldRunDaemonPod): nodeSelector, NoSchedule taints not
+        tolerated. Cordons do NOT exclude: daemon pods carry the
+        unschedulable toleration (ScheduleDaemonSetPods semantics — a
+        cordoned node keeps its daemon), so unschedulable is left to the
+        scheduler's taint filter."""
         nsel = (ds.get("spec", {}).get("template", {}).get("spec", {})
                 .get("nodeSelector") or {})
         nlabels = meta.labels_of(node)
         if any(nlabels.get(k) != v for k, v in nsel.items()):
             return False
-        tolerations = (ds.get("spec", {}).get("template", {}).get("spec", {})
-                       .get("tolerations") or [])
+        # evaluate taints WITH the daemon toleration set the controller
+        # itself adds at creation — otherwise eligibility would delete the
+        # very pods those tolerations exist to keep (e.g. an unreachable
+        # NoExecute taint during a heartbeat gap)
+        tolerations = list(
+            ds.get("spec", {}).get("template", {}).get("spec", {})
+            .get("tolerations") or []) + DAEMON_TOLERATIONS
         for t in node.get("spec", {}).get("taints", []) or []:
             if t.get("effect") not in ("NoSchedule", "NoExecute"):
                 continue
@@ -388,8 +430,7 @@ class DaemonSetController(Controller):
         owned_by_node: Dict[str, List[Dict]] = {}
         for p in self.pod_informer.lister.list(ns):
             if (meta.controller_ref(p) or {}).get("uid") == my_uid:
-                owned_by_node.setdefault(
-                    p.get("spec", {}).get("nodeName", ""), []).append(p)
+                owned_by_node.setdefault(_daemon_pod_target(p), []).append(p)
 
         eligible = [n for n in self.node_informer.lister.list()
                     if self._node_eligible(ds, n)]
@@ -398,15 +439,32 @@ class DaemonSetController(Controller):
             if not owned_by_node.get(nname):
                 p = pod_from_template(ds, ds["spec"].get("template", {}),
                                       generate_name=f"{name}-")
-                # daemon pods pin to the node directly (scheduled by the
-                # daemonset controller pre-1.17 default)
-                p["spec"]["nodeName"] = nname
-                p["spec"].setdefault("tolerations", []).append(
-                    {"operator": "Exists",
-                     "effect": "NoExecute"})
+                # ScheduleDaemonSetPods (GA at the reference's vintage,
+                # daemon_controller.go nodeAffinity path): the pod targets
+                # its node through required node affinity on
+                # metadata.name and is bound by the DEFAULT SCHEDULER —
+                # resources, ports and the full filter chain apply — with
+                # the daemon toleration set letting it land on pressured
+                # or not-ready nodes (util/daemonset_util.go
+                # AddOrUpdateDaemonPodTolerations)
+                aff = p["spec"].setdefault("affinity", {}).setdefault(
+                    "nodeAffinity", {})
+                aff["requiredDuringSchedulingIgnoredDuringExecution"] = {
+                    "nodeSelectorTerms": [{"matchFields": [{
+                        "key": "metadata.name", "operator": "In",
+                        "values": [nname]}]}]}
+                p["spec"].setdefault("tolerations", []).extend(
+                    dict(t) for t in DAEMON_TOLERATIONS)
                 self.client.pods.create(p, ns)
         eligible_names = {meta.name(n) for n in eligible}
         for nname, pods in owned_by_node.items():
+            # keep the best duplicate: bound beats pending, ready beats
+            # not-ready (the reference ranks duplicates the same way) — a
+            # create/lister race must not kill the RUNNING daemon in favor
+            # of its pending twin
+            pods.sort(key=lambda p: (bool(p.get("spec", {})
+                                          .get("nodeName")),
+                                     is_pod_ready(p)), reverse=True)
             extra = pods[1:] if nname in eligible_names else pods
             for p in extra:
                 try:
@@ -414,7 +472,9 @@ class DaemonSetController(Controller):
                 except errors.StatusError:
                     pass
 
-        scheduled = sum(1 for n, ps in owned_by_node.items() if ps and n)
+        scheduled = sum(
+            1 for n, ps in owned_by_node.items()
+            if n and any(p.get("spec", {}).get("nodeName") for p in ps))
         ready = sum(1 for ps in owned_by_node.values()
                     for p in ps if is_pod_ready(p))
         status = {"desiredNumberScheduled": len(eligible),

@@ -47,16 +47,11 @@ def pv_allowed_nodes(pv: Obj) -> Optional[List[str]]:
     """Node names this PV is reachable from, via spec.nodeAffinity matchFields
     on metadata.name; None = no restriction. (Zone-label terms are resolved
     by the scheduler binder against node labels.)"""
-    terms = (pv.get("spec", {}).get("nodeAffinity", {}).get("required", {})
-             .get("nodeSelectorTerms") or [])
-    names: List[str] = []
-    restricted = False
-    for t in terms:
-        for f in t.get("matchFields") or []:
-            if f.get("key") == "metadata.name" and f.get("operator") == "In":
-                restricted = True
-                names.extend(f.get("values") or [])
-    return names if restricted else None
+    from kubernetes_tpu.api.v1 import node_names_from_terms
+
+    return node_names_from_terms(
+        (pv.get("spec", {}).get("nodeAffinity", {}).get("required", {})
+         .get("nodeSelectorTerms") or []))
 
 
 class PersistentVolumeController(Controller):

@@ -212,27 +212,59 @@ class TestStatefulSet:
 
 
 class TestDaemonSet:
-    def test_one_pod_per_eligible_node(self, client, cm):
+    def test_one_pod_per_eligible_node_via_scheduler(self, client, cm):
+        """ScheduleDaemonSetPods: daemon pods carry metadata.name node
+        affinity + the daemon toleration set and are bound by the DEFAULT
+        SCHEDULER — including onto cordoned nodes (the unschedulable
+        toleration), but never onto nodeSelector-excluded ones."""
+        from kubernetes_tpu.sched.server import SchedulerServer
+
+        caps = {"capacity": {"cpu": "4", "memory": "8Gi", "pods": "110"},
+                "allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}}
         for n in ("n1", "n2"):
             client.nodes.create({"apiVersion": "v1", "kind": "Node",
-                                 "metadata": {"name": n}})
+                                 "metadata": {"name": n,
+                                              "labels": {"fleet": "yes"}},
+                                 "status": caps})
         client.nodes.create({"apiVersion": "v1", "kind": "Node",
-                             "metadata": {"name": "cordoned"},
-                             "spec": {"unschedulable": True}})
+                             "metadata": {"name": "cordoned",
+                                          "labels": {"fleet": "yes"}},
+                             "spec": {"unschedulable": True},
+                             "status": caps})
+        client.nodes.create({"apiVersion": "v1", "kind": "Node",
+                             "metadata": {"name": "excluded"},
+                             "status": caps})
         ds = {"apiVersion": "apps/v1", "kind": "DaemonSet",
               "metadata": {"name": "agent", "namespace": "default"},
               "spec": {"selector": {"matchLabels": {"app": "agent"}},
                        "template": {"metadata": {"labels": {"app": "agent"}},
-                                    "spec": {"containers": [{"name": "c", "image": "i"}]}}}}
-        client.daemonsets.create(ds)
+                                    "spec": {"nodeSelector":
+                                             {"fleet": "yes"},
+                                             "containers": [
+                                                 {"name": "c",
+                                                  "image": "i"}]}}}}
+        sched = SchedulerServer(client).start()
+        try:
+            client.daemonsets.create(ds)
 
-        def placed():
-            pods = client.pods.list("default",
-                                    label_selector="app=agent")["items"]
-            nodes = sorted(p["spec"].get("nodeName", "") for p in pods)
-            return nodes == ["n1", "n2"]
+            def placed():
+                pods = client.pods.list("default",
+                                        label_selector="app=agent")["items"]
+                nodes = sorted(p["spec"].get("nodeName", "") for p in pods)
+                return nodes == ["cordoned", "n1", "n2"]
 
-        assert wait_for(placed)
+            assert wait_for(placed, timeout=60)
+            # the pods went THROUGH the scheduler (no controller-pinned
+            # nodeName): each carries the metadata.name affinity
+            for p in client.pods.list("default",
+                                      label_selector="app=agent")["items"]:
+                terms = (p["spec"]["affinity"]["nodeAffinity"]
+                         ["requiredDuringSchedulingIgnoredDuringExecution"]
+                         ["nodeSelectorTerms"])
+                assert terms[0]["matchFields"][0]["values"] == \
+                    [p["spec"]["nodeName"]]
+        finally:
+            sched.stop()
 
 
 class TestEndpointsAndServices:
