@@ -405,6 +405,101 @@ class Kubectl:
         self.out.write(text)
         return 0
 
+    def _deployment_rses(self, name: str, ns: str):
+        from kubernetes_tpu.controllers.workloads import rs_revision
+
+        d = self.client.deployments.get(name, ns)
+        uid = d["metadata"]["uid"]
+        rses = [rs for rs in self.client.replicasets.list(ns)["items"]
+                if any(o.get("uid") == uid and o.get("controller")
+                       for o in rs["metadata"].get("ownerReferences", []))]
+        return d, sorted(rses, key=rs_revision), rs_revision
+
+    def rollout(self, subverb: str, target: str,
+                namespace: str = "default", to_revision: int = 0,
+                timeout: float = 60.0) -> int:
+        """kubectl rollout status|restart|history|undo for deployments
+        (staging/src/k8s.io/kubectl/pkg/cmd/rollout + polymorphichelpers):
+        status polls the observed rollout, restart stamps the template's
+        restartedAt annotation, history lists ReplicaSet revisions, undo
+        re-applies a previous revision's template (becoming the newest
+        revision)."""
+        res, _, name = target.partition("/")
+        if res not in ("deployment", "deployments", "deploy") or not name:
+            self.err.write("error: rollout supports deployment/<name>\n")
+            return 1
+        if subverb == "status":
+            import time as _time
+
+            deadline = _time.monotonic() + timeout
+            while _time.monotonic() < deadline:
+                d = self.client.deployments.get(name, namespace)
+                want = int(d["spec"].get("replicas", 1))
+                st = d.get("status", {})
+                if (st.get("observedGeneration", 0)
+                        >= d["metadata"].get("generation", 0)
+                        and st.get("updatedReplicas", 0) == want
+                        and st.get("readyReplicas", 0) == want
+                        and st.get("replicas", 0) == want):
+                    self.out.write(
+                        f'deployment "{name}" successfully rolled out\n')
+                    return 0
+                _time.sleep(0.2)
+            self.err.write(f'error: deployment "{name}" did not roll out '
+                           f"within {timeout:g}s\n")
+            return 1
+        if subverb == "restart":
+            stamp = meta.now_rfc3339()
+            self.client.deployments.patch(name, {"spec": {"template": {
+                "metadata": {"annotations": {
+                    "kubectl.kubernetes.io/restartedAt": stamp}}}}},
+                namespace)
+            self.out.write(f"deployment.apps/{name} restarted\n")
+            return 0
+        if subverb == "history":
+            _, rses, rev = self._deployment_rses(name, namespace)
+            self.out.write("REVISION  CHANGE-CAUSE\n")
+            for rs in rses:
+                cause = (rs["metadata"].get("annotations") or {}).get(
+                    "kubernetes.io/change-cause", "<none>")
+                self.out.write(f"{rev(rs)}         {cause}\n")
+            return 0
+        if subverb == "undo":
+            d, rses, rev = self._deployment_rses(name, namespace)
+            if to_revision:
+                target_rs = next((rs for rs in rses
+                                  if rev(rs) == to_revision), None)
+                if target_rs is None:
+                    self.err.write(f"error: unable to find revision "
+                                   f"{to_revision} of deployment "
+                                   f"{name!r}\n")
+                    return 1
+            else:
+                if len(rses) < 2:
+                    self.err.write("error: no rollout history found\n")
+                    return 1
+                target_rs = rses[-2]  # previous revision
+            tmpl = meta.deep_copy(target_rs["spec"]["template"])
+            tmpl.get("metadata", {}).get("labels", {}).pop(
+                "pod-template-hash", None)
+            # full-object PUT, not a merge patch: the server's RFC 7386
+            # merge cannot REMOVE template fields added after the target
+            # revision (annotations, env, labels), which would leave a
+            # hybrid spec matching neither revision
+            for _ in range(5):
+                cur = self.client.deployments.get(name, namespace)
+                cur["spec"]["template"] = meta.deep_copy(tmpl)
+                try:
+                    self.client.deployments.update(cur, namespace)
+                    break
+                except errors.StatusError as e:
+                    if not errors.is_conflict(e):
+                        raise
+            self.out.write(f"deployment.apps/{name} rolled back\n")
+            return 0
+        self.err.write(f"error: unknown rollout subcommand {subverb!r}\n")
+        return 1
+
     def top(self, kind: str, namespace: str = "default") -> int:
         """kubectl top pods|nodes (staging/src/k8s.io/kubectl top_*.go):
         reads the aggregated resource-metrics API the metrics-server
@@ -487,6 +582,13 @@ def build_parser() -> argparse.ArgumentParser:
     tp = sub.add_parser("top")
     tp.add_argument("kind", help="pods|nodes")
 
+    ro = sub.add_parser("rollout")
+    ro.add_argument("subverb", choices=["status", "restart", "history",
+                                        "undo"])
+    ro.add_argument("target", help="deployment/<name>")
+    ro.add_argument("--to-revision", type=int, default=0)
+    ro.add_argument("--timeout", type=float, default=60.0)
+
     de = sub.add_parser("delete")
     de.add_argument("resource")
     de.add_argument("name")
@@ -535,6 +637,10 @@ def main(argv: Optional[List[str]] = None, client: Optional[Client] = None,
             return k.explain(args.path)
         if args.verb == "top":
             return k.top(args.kind, args.namespace)
+        if args.verb == "rollout":
+            return k.rollout(args.subverb, args.target, args.namespace,
+                             to_revision=args.to_revision,
+                             timeout=args.timeout)
         if args.verb == "delete":
             return k.delete(args.resource, args.name, args.namespace)
         if args.verb == "scale":

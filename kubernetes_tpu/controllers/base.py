@@ -194,6 +194,10 @@ def pod_from_template(owner: Dict, template: Dict, name: str = "",
         "metadata": {
             "namespace": meta.namespace(owner),
             "labels": dict((template.get("metadata", {}).get("labels")) or {}),
+            # annotations ride along too (GetPodFromTemplate copies both —
+            # rollout restart's restartedAt stamp travels this way)
+            "annotations": dict((template.get("metadata", {})
+                                 .get("annotations")) or {}),
             "ownerReferences": [meta.owner_reference(owner)],
         },
         "spec": meta.deep_copy(template.get("spec", {})),

@@ -125,6 +125,19 @@ def pod_template_hash(template: Dict) -> str:
     return hashlib.sha256(raw.encode()).hexdigest()[:10]
 
 
+REVISION_ANN = "deployment.kubernetes.io/revision"
+
+
+def rs_revision(rs: Dict) -> int:
+    """A ReplicaSet's deployment revision (deployment_util.go Revision):
+    the one parse shared by the controller and kubectl rollout."""
+    try:
+        return int((rs.get("metadata", {}).get("annotations") or {})
+                   .get(REVISION_ANN, 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
 class DeploymentController(Controller):
     """deployment_controller.go syncDeployment: own ReplicaSets keyed by
     pod-template-hash; rolling update scales new up / old down within
@@ -153,6 +166,7 @@ class DeploymentController(Controller):
         new_rs = next((rs for rs in all_rs
                        if rs["metadata"].get("labels", {})
                        .get("pod-template-hash") == thash), None)
+        max_rev = max((rs_revision(rs) for rs in all_rs), default=0)
 
         if new_rs is None:
             tmpl = meta.deep_copy(template)
@@ -165,6 +179,10 @@ class DeploymentController(Controller):
                 "metadata": {
                     "name": f"{name}-{thash}", "namespace": ns,
                     "labels": dict(tmpl["metadata"]["labels"]),
+                    # revision history (deployment_util.go Revision/
+                    # SetNewReplicaSetAnnotations): every template change
+                    # gets the next revision; rollout history/undo read it
+                    "annotations": {REVISION_ANN: str(max_rev + 1)},
                     "ownerReferences": [meta.owner_reference(d)],
                 },
                 "spec": {"replicas": 0, "selector": sel, "template": tmpl},
@@ -176,6 +194,19 @@ class DeploymentController(Controller):
                     raise
                 new_rs = self.client.replicasets.get(f"{name}-{thash}", ns)
             self.enqueue_key(key)  # reconcile scaling next pass
+        else:
+            my_rev = rs_revision(new_rs)
+            if my_rev < max_rev:
+                # a rollback re-activated an old template: it becomes the
+                # NEWEST revision (deployment_util.go: revision bumps, the
+                # history never rewinds)
+                try:
+                    cur = self.client.replicasets.get(meta.name(new_rs), ns)
+                    cur["metadata"].setdefault("annotations", {})[
+                        REVISION_ANN] = str(max_rev + 1)
+                    self.client.replicasets.update(cur, ns)
+                except errors.StatusError:
+                    pass
 
         old_rses = [rs for rs in all_rs
                     if meta.name(rs) != meta.name(new_rs)]
@@ -429,8 +460,18 @@ class DaemonSetController(Controller):
         my_uid = meta.uid(ds)
         owned_by_node: Dict[str, List[Dict]] = {}
         for p in self.pod_informer.lister.list(ns):
-            if (meta.controller_ref(p) or {}).get("uid") == my_uid:
-                owned_by_node.setdefault(_daemon_pod_target(p), []).append(p)
+            if (meta.controller_ref(p) or {}).get("uid") != my_uid:
+                continue
+            if p.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+                # a terminated daemon pod is deleted and replaced, never
+                # counted (podsShouldBeOnNode: failed daemon pods are
+                # backoff-deleted so the node gets a fresh one)
+                try:
+                    self.client.pods.delete(meta.name(p), ns)
+                except errors.StatusError:
+                    pass
+                continue
+            owned_by_node.setdefault(_daemon_pod_target(p), []).append(p)
 
         eligible = [n for n in self.node_informer.lister.list()
                     if self._node_eligible(ds, n)]

@@ -271,6 +271,83 @@ spec:
             assert kubectl_main(argv_base + ["version"], out=out) == 0
             assert "tpu" in out.getvalue()
 
+    def test_kubectl_rollout_lifecycle(self):
+        """rollout status / history / undo / restart against a live cluster
+        (kubectl/pkg/cmd/rollout): revisions accrue on template changes,
+        undo re-applies the previous template as the NEWEST revision."""
+        with Cluster(ClusterConfig(hollow_nodes=2)) as cluster:
+            client = cluster.client
+            argv = ["-s", cluster.url]
+            client.deployments.create({
+                "apiVersion": "apps/v1", "kind": "Deployment",
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {"replicas": 2,
+                         "selector": {"matchLabels": {"app": "web"}},
+                         "template": {
+                             "metadata": {"labels": {"app": "web"}},
+                             "spec": {"containers": [{
+                                 "name": "c", "image": "img:v1"}]}}}})
+            out = io.StringIO()
+            assert kubectl_main(argv + ["rollout", "status",
+                                        "deployment/web"], out=out) == 0
+            assert "successfully rolled out" in out.getvalue()
+
+            # template change → revision 2
+            d = client.deployments.get("web")
+            d["spec"]["template"]["spec"]["containers"][0]["image"] = \
+                "img:v2"
+            client.deployments.update(d, "default")
+            assert kubectl_main(argv + ["rollout", "status",
+                                        "deployment/web"],
+                                out=io.StringIO()) == 0
+            out = io.StringIO()
+            assert kubectl_main(argv + ["rollout", "history",
+                                        "deployment/web"], out=out) == 0
+            hist = out.getvalue()
+            assert "1" in hist and "2" in hist
+
+            # undo → v1 template returns as revision 3
+            assert kubectl_main(argv + ["rollout", "undo",
+                                        "deployment/web"],
+                                out=io.StringIO()) == 0
+            assert kubectl_main(argv + ["rollout", "status",
+                                        "deployment/web"],
+                                out=io.StringIO()) == 0
+            d = client.deployments.get("web")
+            assert d["spec"]["template"]["spec"]["containers"][0][
+                "image"] == "img:v1"
+            out = io.StringIO()
+            kubectl_main(argv + ["rollout", "history", "deployment/web"],
+                         out=out)
+            assert "3" in out.getvalue()
+
+            # restart stamps the template → yet another revision, pods roll
+            assert kubectl_main(argv + ["rollout", "restart",
+                                        "deployment/web"],
+                                out=io.StringIO()) == 0
+            assert kubectl_main(argv + ["rollout", "status",
+                                        "deployment/web"],
+                                out=io.StringIO()) == 0
+            pods = client.pods.list("default",
+                                    label_selector="app=web")["items"]
+            assert all(p["spec"]["containers"][0]["image"] == "img:v1"
+                       for p in pods)
+            assert all((p["metadata"].get("annotations") or {}).get(
+                "kubectl.kubernetes.io/restartedAt")
+                for p in pods), "restart must re-template the pods"
+
+            # undo after restart must REMOVE the restartedAt stamp — a
+            # merge patch can't delete fields, so undo must replace the
+            # template wholesale (code-review regression)
+            assert kubectl_main(argv + ["rollout", "undo",
+                                        "deployment/web"],
+                                out=io.StringIO()) == 0
+            d = client.deployments.get("web")
+            anns = (d["spec"]["template"]["metadata"]
+                    .get("annotations") or {})
+            assert "kubectl.kubernetes.io/restartedAt" not in anns, \
+                "undo left the restart stamp behind (hybrid template)"
+
     def test_kubectl_explain_and_diff(self, api, tmp_path):
         gw = HTTPGateway(api).start()
         try:

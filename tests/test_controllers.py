@@ -281,7 +281,8 @@ class TestEndpointsAndServices:
             "spec": {"containers": [{"name": "c", "image": "i"}], "nodeName": "n1"}})
         mark_pods_running(client, selector="app=web")
         assert wait_for(lambda: (client.endpoints.get("web")
-                                 .get("subsets") or [{}])[0].get("addresses"))
+                                 .get("subsets") or [{}])[0].get("addresses"),
+                        timeout=30)
         ep = client.endpoints.get("web")
         assert ep["subsets"][0]["addresses"][0]["targetRef"]["name"] == "w1"
         assert ep["subsets"][0]["ports"][0]["port"] == 8080
