@@ -495,6 +495,10 @@ class Kubectl:
                 except errors.StatusError as e:
                     if not errors.is_conflict(e):
                         raise
+            else:
+                self.err.write("error: rollback write kept conflicting; "
+                               "retry\n")
+                return 1
             self.out.write(f"deployment.apps/{name} rolled back\n")
             return 0
         self.err.write(f"error: unknown rollout subcommand {subverb!r}\n")

@@ -33,6 +33,7 @@ from kubernetes_tpu.controllers.workloads import (
     JobController,
     ReplicaSetController,
     StatefulSetController,
+    TTLAfterFinishedController,
     pod_template_hash,
 )
 
@@ -44,5 +45,6 @@ __all__ = [
     "JobController", "NamespaceController", "NodeLifecycleController",
     "PodGCController", "ReplicaSetController", "ResourceQuotaController",
     "StatefulSetController", "TAINT_NOT_READY", "TAINT_UNREACHABLE",
+    "TTLAfterFinishedController",
     "is_pod_active", "is_pod_ready", "pod_from_template", "pod_template_hash",
 ]

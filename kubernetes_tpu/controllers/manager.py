@@ -39,6 +39,7 @@ from kubernetes_tpu.controllers.workloads import (
     DaemonSetController,
     DeploymentController,
     JobController,
+    TTLAfterFinishedController,
     ReplicaSetController,
     StatefulSetController,
 )
@@ -54,6 +55,7 @@ DEFAULT_CONTROLLERS: Dict[str, Callable] = {
     "cronjob": CronJobController,
     "endpoints": EndpointsController,
     "endpointslice": EndpointSliceController,
+    "ttlafterfinished": TTLAfterFinishedController,
     "nodelifecycle": NodeLifecycleController,
     "namespace": NamespaceController,
     "garbagecollector": GarbageCollector,
@@ -125,7 +127,8 @@ class ControllerManager:
                     self.resync()
                 except Exception:  # noqa: BLE001
                     pass
-            for name in ("nodelifecycle", "cronjob", "podgc"):
+            for name in ("nodelifecycle", "cronjob", "podgc", "job",
+                         "ttlafterfinished"):
                 c = self.controllers.get(name)
                 if c is not None and hasattr(c, "poll_once"):
                     try:

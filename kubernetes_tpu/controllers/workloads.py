@@ -406,10 +406,15 @@ class DaemonSetController(Controller):
 
     name = "daemonset"
 
-    def __init__(self, client, factory: InformerFactory):
+    def __init__(self, client, factory: InformerFactory, clock=time.time):
         super().__init__(client, factory)
+        self.clock = clock
         self.ds_informer = self.watch_resource("daemonsets")
         self.pod_informer = self.watch_owned("pods", "DaemonSet")
+        # failed-daemon backoff (daemon_controller.go failedPodsBackoff,
+        # 1s→2^n capped): a crash-failing daemon must not delete/create in
+        # a tight loop as fast as events arrive
+        self._failed_backoff: Dict[tuple, tuple] = {}  # (key,node)→(n,next)
         # node changes re-sync every daemonset
         self.node_informer = self.factory.informer("nodes")
         self.node_informer.add_handlers(
@@ -462,10 +467,16 @@ class DaemonSetController(Controller):
         for p in self.pod_informer.lister.list(ns):
             if (meta.controller_ref(p) or {}).get("uid") != my_uid:
                 continue
-            if p.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+            phase = p.get("status", {}).get("phase")
+            if phase in ("Succeeded", "Failed"):
                 # a terminated daemon pod is deleted and replaced, never
-                # counted (podsShouldBeOnNode: failed daemon pods are
-                # backoff-deleted so the node gets a fresh one)
+                # counted (podsShouldBeOnNode) — replacement honors the
+                # per-node failure backoff below
+                if phase == "Failed":
+                    bkey = (key, _daemon_pod_target(p))
+                    n, _ = self._failed_backoff.get(bkey, (0, 0.0))
+                    self._failed_backoff[bkey] = (
+                        n + 1, self.clock() + min(2.0 ** n, 300.0))
                 try:
                     self.client.pods.delete(meta.name(p), ns)
                 except errors.StatusError:
@@ -478,6 +489,12 @@ class DaemonSetController(Controller):
         for node in eligible:
             nname = meta.name(node)
             if not owned_by_node.get(nname):
+                _, until = self._failed_backoff.get((key, nname), (0, 0.0))
+                if self.clock() < until:
+                    # the manager's periodic resync re-enqueues after the
+                    # backoff window; an immediate re-enqueue here would be
+                    # the busy loop the backoff exists to prevent
+                    continue
                 p = pod_from_template(ds, ds["spec"].get("template", {}),
                                       generate_name=f"{name}-")
                 # ScheduleDaemonSetPods (GA at the reference's vintage,
@@ -538,12 +555,26 @@ class JobController(Controller):
 
     name = "job"
 
-    def __init__(self, client, factory: InformerFactory):
+    def __init__(self, client, factory: InformerFactory, clock=time.time):
         super().__init__(client, factory)
         self.expectations = Expectations()
+        self.clock = clock
         self.job_informer = self.watch_resource("jobs")
         self.pod_informer = self.watch_owned("pods", "Job",
                                              expectations=self.expectations)
+
+    def poll_once(self, now=None) -> None:
+        """Deadline sweep (the reference re-enqueues at the deadline via
+        AddAfter; here the manager's poll tick drives it). Finished jobs
+        are skipped — the sweep stays proportional to in-flight work."""
+        for job in self.job_informer.lister.list():
+            if job.get("spec", {}).get("activeDeadlineSeconds") is None:
+                continue
+            if any(c.get("type") in ("Complete", "Failed")
+                   and c.get("status") == "True"
+                   for c in job.get("status", {}).get("conditions", [])):
+                continue
+            self.enqueue(job)
 
     def sync(self, key: str) -> None:
         ns, name = meta.split_key(key)
@@ -570,8 +601,28 @@ class JobController(Controller):
         done = any(c.get("type") in ("Complete", "Failed")
                    and c.get("status") == "True" for c in conditions)
 
+        now = self.clock()
+        start_unix = job.get("status", {}).get("startUnix") or now
+        deadline = spec.get("activeDeadlineSeconds")
+        past_deadline = (
+            not done and deadline is not None
+            and now - start_unix >= float(deadline))
+
         if not done:
-            if failed > backoff_limit:
+            if past_deadline:
+                # syncJob pastActiveDeadline: the job fails wholesale and
+                # its active pods are killed (job_controller.go)
+                conditions.append({"type": "Failed", "status": "True",
+                                   "reason": "DeadlineExceeded",
+                                   "message": "Job was active longer than "
+                                              "specified deadline",
+                                   "lastTransitionTime": meta.now_rfc3339()})
+                for p in active:
+                    try:
+                        self.client.pods.delete(meta.name(p), ns)
+                    except errors.StatusError:
+                        pass
+            elif failed > backoff_limit:
                 conditions.append({"type": "Failed", "status": "True",
                                    "reason": "BackoffLimitExceeded",
                                    "lastTransitionTime": meta.now_rfc3339()})
@@ -601,7 +652,15 @@ class JobController(Controller):
                         self.expectations.creation_observed(key)
 
         status = {"active": len(active), "succeeded": succeeded,
-                  "failed": failed, "conditions": conditions}
+                  "failed": failed, "conditions": conditions,
+                  # startUnix/completionUnix: the float-clock carriers this
+                  # codebase uses beside RFC3339 strings (cf. the kubelet's
+                  # heartbeatUnix) — deadline + TTL math reads them
+                  "startUnix": job.get("status", {}).get("startUnix", now)}
+        if any(c.get("type") in ("Complete", "Failed")
+               and c.get("status") == "True" for c in conditions):
+            status["completionUnix"] = job.get("status", {}).get(
+                "completionUnix", now)
         if job.get("status", {}) != status:
             cur = meta.deep_copy(job)
             cur["status"] = status
@@ -691,3 +750,51 @@ def cron_period_seconds(schedule: str) -> Optional[float]:
             return 60.0
         return 3600.0  # fixed minute ⇒ hourly cadence
     return None
+
+
+class TTLAfterFinishedController(Controller):
+    """ttlafterfinished/ttlafterfinished_controller.go: finished Jobs
+    carrying spec.ttlSecondsAfterFinished are deleted once the TTL since
+    completion elapses (the pods follow through ownerRef GC). Poll-driven
+    here, like the reference's AddAfter requeues collapsed onto the
+    manager's tick."""
+
+    name = "ttlafterfinished"
+
+    def __init__(self, client, factory: InformerFactory, clock=time.time):
+        super().__init__(client, factory)
+        self.clock = clock
+        self.job_informer = self.watch_resource("jobs")
+
+    def poll_once(self, now=None) -> None:
+        # `now` is the manager's poll signature; the sync path reads the
+        # controller clock itself at decision time
+        for job in self.job_informer.lister.list():
+            if job.get("spec", {}).get("ttlSecondsAfterFinished") is None:
+                continue
+            if any(c.get("type") in ("Complete", "Failed")
+                   and c.get("status") == "True"
+                   for c in job.get("status", {}).get("conditions", [])):
+                self.enqueue(job)
+
+    def sync(self, key: str) -> None:
+        ns, name = meta.split_key(key)
+        job = self.job_informer.lister.get(ns, name)
+        if job is None or meta.is_being_deleted(job):
+            return
+        ttl = job.get("spec", {}).get("ttlSecondsAfterFinished")
+        if ttl is None:
+            return
+        st = job.get("status", {})
+        if not any(c.get("type") in ("Complete", "Failed")
+                   and c.get("status") == "True"
+                   for c in st.get("conditions", [])):
+            return
+        finished = st.get("completionUnix")
+        if finished is None:
+            return  # pre-TTL-era status; next job sync stamps it
+        if self.clock() - float(finished) >= float(ttl):
+            try:
+                self.client.jobs.delete(name, ns)
+            except errors.StatusError:
+                pass

@@ -123,9 +123,18 @@ class TestDeployment:
             "default", label_selector="app=roll")["items"]) == 2)
         mark_pods_running(client, selector="app=roll")
         # new template → new RS; old scales away as new pods turn Ready
-        d = client.deployments.get("roll")
-        d["spec"]["template"]["spec"]["containers"][0]["image"] = "img:v2"
-        client.deployments.update(d)
+        # (CAS-retry: the deployment controller's status writes race this)
+        for _ in range(10):
+            d = client.deployments.get("roll")
+            d["spec"]["template"]["spec"]["containers"][0]["image"] = "img:v2"
+            try:
+                client.deployments.update(d)
+                break
+            except errors.StatusError as e:
+                if not errors.is_conflict(e):
+                    raise
+        else:
+            pytest.fail("deployment update kept conflicting")
 
         def converged():
             mark_pods_running(client, selector="app=roll")
@@ -286,6 +295,102 @@ class TestEndpointsAndServices:
         ep = client.endpoints.get("web")
         assert ep["subsets"][0]["addresses"][0]["targetRef"]["name"] == "w1"
         assert ep["subsets"][0]["ports"][0]["port"] == 8080
+
+
+class TestJobDeadlineAndTTL:
+    def test_active_deadline_fails_the_job(self, client):
+        """job_controller.go pastActiveDeadline: Failed/DeadlineExceeded,
+        active pods killed."""
+        from kubernetes_tpu.client import InformerFactory
+        from kubernetes_tpu.controllers import JobController
+
+        fake_now = [1000.0]
+        factory = InformerFactory(client)
+        jc = JobController(client, factory, clock=lambda: fake_now[0])
+        factory.start()
+        factory.wait_for_sync()
+        jc.start()
+        try:
+            client.jobs.create({
+                "apiVersion": "batch/v1", "kind": "Job",
+                "metadata": {"name": "slow", "namespace": "default"},
+                "spec": {"activeDeadlineSeconds": 30,
+                         "template": {"metadata": {"labels": {"j": "slow"}},
+                                      "spec": {"restartPolicy": "Never",
+                                               "containers": [
+                                                   {"name": "c",
+                                                    "image": "i"}]}}}})
+            assert wait_for(lambda: client.jobs.get("slow")
+                            .get("status", {}).get("active", 0) == 1)
+            fake_now[0] += 31
+            jc.poll_once()
+            assert wait_for(lambda: any(
+                c.get("reason") == "DeadlineExceeded"
+                for c in client.jobs.get("slow").get("status", {})
+                .get("conditions", [])), timeout=15)
+            assert wait_for(lambda: client.pods.list(
+                "default", label_selector="j=slow")["items"] == [])
+        finally:
+            jc.stop()
+            factory.stop()
+
+    def test_ttl_after_finished_deletes_job(self, client):
+        """ttlafterfinished: a finished job with the TTL set is deleted
+        once the TTL elapses; without the field it stays forever."""
+        from kubernetes_tpu.client import InformerFactory
+        from kubernetes_tpu.controllers import (
+            JobController, TTLAfterFinishedController)
+
+        fake_now = [5000.0]
+        factory = InformerFactory(client)
+        jc = JobController(client, factory, clock=lambda: fake_now[0])
+        ttl = TTLAfterFinishedController(client, factory,
+                                         clock=lambda: fake_now[0])
+        factory.start()
+        factory.wait_for_sync()
+        jc.start()
+        ttl.start()
+        try:
+            for name, spec_extra in (("fleeting",
+                                      {"ttlSecondsAfterFinished": 60}),
+                                     ("keeper", {})):
+                client.jobs.create({
+                    "apiVersion": "batch/v1", "kind": "Job",
+                    "metadata": {"name": name, "namespace": "default"},
+                    "spec": {**spec_extra,
+                             "template": {
+                                 "metadata": {"labels": {"j": name}},
+                                 "spec": {"restartPolicy": "Never",
+                                          "containers": [{"name": "c",
+                                                          "image": "i"}]}}}})
+            # finish both jobs by succeeding their pods
+            def finish(name):
+                for p in client.pods.list(
+                        "default", label_selector=f"j={name}")["items"]:
+                    p["status"] = {"phase": "Succeeded"}
+                    client.pods.update_status(p, "default")
+            assert wait_for(lambda: all(
+                client.jobs.get(n).get("status", {}).get("active", 0) == 1
+                for n in ("fleeting", "keeper")))
+            finish("fleeting")
+            finish("keeper")
+            assert wait_for(lambda: all(any(
+                c.get("type") == "Complete" and c.get("status") == "True"
+                for c in client.jobs.get(n).get("status", {})
+                .get("conditions", [])) for n in ("fleeting", "keeper")))
+            # before the TTL: both survive
+            ttl.poll_once()
+            time.sleep(0.3)
+            assert client.jobs.get("fleeting")
+            fake_now[0] += 61
+            ttl.poll_once()
+            assert wait_for(lambda: not _exists(
+                client.jobs, "fleeting", "default"), timeout=15)
+            assert client.jobs.get("keeper")
+        finally:
+            ttl.stop()
+            jc.stop()
+            factory.stop()
 
 
 class TestEndpointSlices:
