@@ -128,7 +128,7 @@ class ControllerManager:
                 except Exception:  # noqa: BLE001
                     pass
             for name in ("nodelifecycle", "cronjob", "podgc", "job",
-                         "ttlafterfinished"):
+                         "ttlafterfinished", "daemonset"):
                 c = self.controllers.get(name)
                 if c is not None and hasattr(c, "poll_once"):
                     try:

@@ -300,6 +300,41 @@ class StatefulSetController(Controller):
         self.ss_informer = self.watch_resource("statefulsets")
         self.pod_informer = self.watch_owned("pods", "StatefulSet")
 
+    def _ensure_claims(self, ss: Dict, ns: str, name: str, ordinal: int,
+                       pod: Dict) -> None:
+        """volumeClaimTemplates → one PVC per template per ordinal,
+        `<tmpl>-<sts>-<ordinal>` (stateful_set_utils.go getPersistentVolume
+        Claims), wired into the pod's volumes. Claims are RETAINED across
+        pod deletion and scale-down — the stable-storage contract — so an
+        ordinal that comes back rebinds its old data."""
+        for vct in ss.get("spec", {}).get("volumeClaimTemplates", []) or []:
+            cname = (vct.get("metadata", {}) or {}).get("name", "data")
+            claim_name = f"{cname}-{name}-{ordinal}"
+            try:
+                self.client.persistentvolumeclaims.get(claim_name, ns)
+            except errors.StatusError:
+                tmpl_labels = ((ss.get("spec", {}).get("template", {})
+                                .get("metadata", {}) or {})
+                               .get("labels") or {})
+                claim = {
+                    "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+                    "metadata": {"name": claim_name, "namespace": ns,
+                                 "labels": dict(tmpl_labels)},
+                    "spec": meta.deep_copy(vct.get("spec", {})),
+                }
+                try:
+                    self.client.persistentvolumeclaims.create(claim, ns)
+                except errors.StatusError as e:
+                    if not errors.is_already_exists(e):
+                        raise
+            # the claim OWNS its name: a same-named template volume is
+            # replaced, not shadowed (stateful_set_utils.go updateStorage)
+            vols = pod["spec"].setdefault("volumes", [])
+            vols[:] = [v for v in vols if v.get("name") != cname]
+            vols.append({"name": cname,
+                         "persistentVolumeClaim":
+                         {"claimName": claim_name}})
+
     def sync(self, key: str) -> None:
         ns, name = meta.split_key(key)
         ss = self.ss_informer.lister.get(ns, name)
@@ -323,6 +358,7 @@ class StatefulSetController(Controller):
                     "statefulset.kubernetes.io/pod-name"] = pname
                 p["spec"]["hostname"] = pname
                 p["spec"]["subdomain"] = spec.get("serviceName", "")
+                self._ensure_claims(ss, ns, name, i, p)
                 try:
                     self.client.pods.create(p, ns)
                 except errors.StatusError as e:
@@ -413,8 +449,20 @@ class DaemonSetController(Controller):
         self.pod_informer = self.watch_owned("pods", "DaemonSet")
         # failed-daemon backoff (daemon_controller.go failedPodsBackoff,
         # 1s→2^n capped): a crash-failing daemon must not delete/create in
-        # a tight loop as fast as events arrive
+        # a tight loop as fast as events arrive. Bumps once per failed POD
+        # (by uid, not per sync observing the cached corpse); resets when
+        # the node's daemon turns Ready; pruned with its DaemonSet.
         self._failed_backoff: Dict[tuple, tuple] = {}  # (key,node)→(n,next)
+        self._counted_failures: set = set()            # pod uids
+
+    def poll_once(self, now=None) -> None:
+        """Backoff-expiry retries: nothing re-enqueues a DaemonSet when a
+        replacement window lapses (no AddAfter machinery), so the manager's
+        poll tick drives it — only for sets that actually hold backoffs."""
+        pending = {k for (k, _n) in self._failed_backoff}
+        for ds in self.ds_informer.lister.list():
+            if meta.namespaced_key(ds) in pending:
+                self.enqueue(ds)
         # node changes re-sync every daemonset
         self.node_informer = self.factory.informer("nodes")
         self.node_informer.add_handlers(
@@ -461,6 +509,10 @@ class DaemonSetController(Controller):
         ns, name = meta.split_key(key)
         ds = self.ds_informer.lister.get(ns, name)
         if ds is None or meta.is_being_deleted(ds):
+            for bk in [bk for bk in self._failed_backoff if bk[0] == key]:
+                del self._failed_backoff[bk]
+            if len(self._counted_failures) > 4096:
+                self._counted_failures.clear()  # bounded: uids are one-shot
             return
         my_uid = meta.uid(ds)
         owned_by_node: Dict[str, List[Dict]] = {}
@@ -472,7 +524,9 @@ class DaemonSetController(Controller):
                 # a terminated daemon pod is deleted and replaced, never
                 # counted (podsShouldBeOnNode) — replacement honors the
                 # per-node failure backoff below
-                if phase == "Failed":
+                if phase == "Failed" and meta.uid(p) not in \
+                        self._counted_failures:
+                    self._counted_failures.add(meta.uid(p))
                     bkey = (key, _daemon_pod_target(p))
                     n, _ = self._failed_backoff.get(bkey, (0, 0.0))
                     self._failed_backoff[bkey] = (
@@ -488,7 +542,12 @@ class DaemonSetController(Controller):
                     if self._node_eligible(ds, n)]
         for node in eligible:
             nname = meta.name(node)
-            if not owned_by_node.get(nname):
+            node_pods = owned_by_node.get(nname)
+            if node_pods and any(is_pod_ready(p) for p in node_pods):
+                # the replacement runs: the slate is clean
+                # (failedPodsBackoff resets after sustained success)
+                self._failed_backoff.pop((key, nname), None)
+            if not node_pods:
                 _, until = self._failed_backoff.get((key, nname), (0, 0.0))
                 if self.clock() < until:
                     # the manager's periodic resync re-enqueues after the

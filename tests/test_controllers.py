@@ -194,6 +194,53 @@ class TestJob:
 
 
 class TestStatefulSet:
+    def test_volume_claim_templates(self, client, cm):
+        """stateful_set_utils.go getPersistentVolumeClaims: one PVC per
+        template per ordinal, retained across scale-down, rebound on
+        scale-up."""
+        ss = {"apiVersion": "apps/v1", "kind": "StatefulSet",
+              "metadata": {"name": "pg", "namespace": "default"},
+              "spec": {"replicas": 2, "serviceName": "pg",
+                       "podManagementPolicy": "Parallel",
+                       "selector": {"matchLabels": {"app": "pg"}},
+                       "volumeClaimTemplates": [{
+                           "metadata": {"name": "data"},
+                           "spec": {"accessModes": ["ReadWriteOnce"],
+                                    "resources": {"requests": {
+                                        "storage": "1Gi"}}}}],
+                       "template": {
+                           "metadata": {"labels": {"app": "pg"}},
+                           "spec": {"containers": [{"name": "c",
+                                                    "image": "i"}]}}}}
+        client.statefulsets.create(ss)
+        assert wait_for(lambda: {p["metadata"]["name"] for p in
+                                 client.pods.list("default",
+                                 label_selector="app=pg")["items"]}
+                        == {"pg-0", "pg-1"})
+        # one claim per ordinal, wired into the pod's volumes
+        for i in range(2):
+            pvc = client.persistentvolumeclaims.get(f"data-pg-{i}")
+            assert pvc["spec"]["resources"]["requests"]["storage"] == "1Gi"
+            pod = client.pods.get(f"pg-{i}")
+            assert any(v.get("persistentVolumeClaim", {})
+                       .get("claimName") == f"data-pg-{i}"
+                       for v in pod["spec"].get("volumes", []))
+        # scale down: pod goes, claim STAYS
+        cur = client.statefulsets.get("pg")
+        cur["spec"]["replicas"] = 1
+        client.statefulsets.update(cur)
+        assert wait_for(lambda: not _exists(client.pods, "pg-1"))
+        assert client.persistentvolumeclaims.get("data-pg-1")
+        # scale back up: the ordinal rebinds its retained claim
+        cur = client.statefulsets.get("pg")
+        cur["spec"]["replicas"] = 2
+        client.statefulsets.update(cur)
+        assert wait_for(lambda: _exists(client.pods, "pg-1"))
+        pod = client.pods.get("pg-1")
+        assert any(v.get("persistentVolumeClaim", {})
+                   .get("claimName") == "data-pg-1"
+                   for v in pod["spec"].get("volumes", []))
+
     def test_ordered_stable_identity(self, client, cm):
         ss = {"apiVersion": "apps/v1", "kind": "StatefulSet",
               "metadata": {"name": "db", "namespace": "default"},
