@@ -116,12 +116,17 @@ def _run_stage(n_nodes, n_pods, kind, env, timeout):
 
 
 def _probe_backend(timeout):
-    """Decide the backend: try the real chip (one retry), else CPU fallback."""
+    """Decide the backend: try the real chip (one retry), else CPU fallback.
+    The probe gets a TIGHT timeout: a dead TPU tunnel makes backend init
+    HANG (not fail), and burning 2 × the full stage timeout on a hung
+    probe would eat the run's budget before the CPU fallback starts."""
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         return _cpu_env(os.environ), "cpu (forced)", []
+    probe_timeout = min(timeout, int(os.environ.get(
+        "BENCH_PROBE_TIMEOUT", "300")))
     diags = []
     for attempt in (1, 2):
-        r = _run_stage(16, 32, "flagship", dict(os.environ), timeout)
+        r = _run_stage(16, 32, "flagship", dict(os.environ), probe_timeout)
         if r.get("ok"):
             return dict(os.environ), r.get("backend", "tpu"), diags
         diags.append({"probe_attempt": attempt, **r})
