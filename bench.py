@@ -122,8 +122,12 @@ def _probe_backend(timeout):
     probe would eat the run's budget before the CPU fallback starts."""
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         return _cpu_env(os.environ), "cpu (forced)", []
-    probe_timeout = min(timeout, int(os.environ.get(
-        "BENCH_PROBE_TIMEOUT", "300")))
+    # an explicit operator override wins even past the stage timeout (a
+    # slow-initializing backend is not a dead one); only the DEFAULT is
+    # capped by the stage budget
+    env_probe = os.environ.get("BENCH_PROBE_TIMEOUT")
+    probe_timeout = int(env_probe) if env_probe \
+        else min(timeout, 300)
     diags = []
     for attempt in (1, 2):
         r = _run_stage(16, 32, "flagship", dict(os.environ), probe_timeout)

@@ -91,6 +91,11 @@ class KubeSchedulerConfiguration:
     hard_pod_affinity_symmetric_weight: int = 1   # :70 (default 1)
     disable_preemption: bool = False       # :76
     percentage_of_nodes_to_score: int = 0  # :86; 0 = adaptive default
+    # TPU-specific extension (no reference analog — the BASELINE's opt-in
+    # knobs live in ComponentConfig): the wave engine's per-class score
+    # admission window (ops/lattice.py EngineConfig.w_window, PARITY #3).
+    # Default MaxNodeScore=100; 0 = strict per-wave argmax tiers.
+    score_admission_window: float = 100.0
     bind_timeout_seconds: float = 600.0    # :91
     pod_initial_backoff_seconds: float = 1.0   # :96
     pod_max_backoff_seconds: float = 10.0      # :101
@@ -148,8 +153,10 @@ class KubeSchedulerConfiguration:
             w_interpod=w("InterPodAffinity"),
             w_even=w("PodTopologySpread"),
             w_ssel=max(w("SelectorSpread"), w("DefaultPodTopologySpread")),
+            w_window=float(self.score_admission_window),
         ) if (self.plugins is not None or self.score_weights) \
-            else default_engine_config()
+            else default_engine_config()._replace(
+                w_window=float(self.score_admission_window))
 
     def build_framework(self) -> Framework:
         return Framework(
@@ -234,6 +241,12 @@ def load_config(source) -> KubeSchedulerConfiguration:
         disable_preemption=bool(data.get("disablePreemption", False)),
         percentage_of_nodes_to_score=int(
             data.get("percentageOfNodesToScore", 0)),
+        # clamped non-negative (NaN → default): a negative window would
+        # make even the per-class argmax inadmissible — a silent total
+        # scheduling outage from a typo
+        score_admission_window=(
+            lambda v: v if v == v and v >= 0 else 100.0)(
+                float(data.get("scoreAdmissionWindow", 100.0))),
         bind_timeout_seconds=float(data.get("bindTimeoutSeconds", 600)),
         pod_initial_backoff_seconds=float(
             data.get("podInitialBackoffSeconds", 1)),

@@ -120,6 +120,26 @@ def test_percentage_of_nodes_to_score_warns_ignored(caplog):
     assert not any("IGNORED" in r.message for r in caplog.records)
 
 
+def test_score_admission_window_flows_to_engine_config():
+    """TPU-specific ComponentConfig extension: scoreAdmissionWindow drives
+    EngineConfig.w_window (the wave engine's per-class admission window,
+    PARITY #3); default MaxNodeScore=100."""
+    assert float(load_config({}).engine_config().w_window) == 100.0
+    cfg = load_config({"scoreAdmissionWindow": 0})
+    assert cfg.score_admission_window == 0.0
+    assert float(cfg.engine_config().w_window) == 0.0
+    cfg = load_config({"scoreAdmissionWindow": 250,
+                       "plugins": {"score": {"enabled": ["ImageLocality"]}}})
+    assert float(cfg.engine_config().w_window) == 250.0
+    # negative / NaN inputs clamp to the default: a window below zero
+    # would disqualify even the per-class argmax (total outage)
+    assert load_config(
+        {"scoreAdmissionWindow": -5}).score_admission_window == 100.0
+    assert load_config(
+        {"scoreAdmissionWindow": float("nan")}).score_admission_window \
+        == 100.0
+
+
 def test_policy_json_composition():
     policy = {
         "kind": "Policy",
