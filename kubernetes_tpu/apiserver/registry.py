@@ -285,7 +285,8 @@ class Store:
         return out
 
     def watch(self, namespace: str = "", label_selector: str = "",
-              field_selector: str = "", resource_version: str = "") -> mwatch.Watch:
+              field_selector: str = "", resource_version: str = "",
+              allow_bookmarks: bool = False) -> mwatch.Watch:
         lsel = mlabels.parse(label_selector) if label_selector else None
         freqs = parse_field_selector(field_selector)
 
@@ -297,7 +298,8 @@ class Store:
             return True
 
         return self.storage.watch(self.prefix_for(namespace),
-                                  since_rv=resource_version, predicate=pred)
+                                  since_rv=resource_version, predicate=pred,
+                                  bookmarks=allow_bookmarks)
 
 
 def _spec_changed(old: Obj, new: Obj) -> bool:

@@ -520,10 +520,13 @@ def _handle_rest_inner(api: APIServer, method: str, path: str,
     fsel = query.get("fieldSelector", "")
     rv = query.get("resourceVersion", "")
     watching = query.get("watch", "") in ("true", "1")
+    # WatchBookmarks opt-in (apiserver watch handler's allowWatchBookmarks)
+    bookmarks = query.get("allowWatchBookmarks", "") in ("true", "1")
 
     if not name:
         if watching:
-            return "WATCH", st.watch(namespace, lsel, fsel, rv)
+            return "WATCH", st.watch(namespace, lsel, fsel, rv,
+                                     allow_bookmarks=bookmarks)
         if method == "GET":
             return 200, st.list(namespace, lsel, fsel)
         if method == "POST":
@@ -561,7 +564,7 @@ def _handle_rest_inner(api: APIServer, method: str, path: str,
     if watching:
         return "WATCH", st.watch(namespace, lsel,
                                  f"metadata.name={name}" + (f",{fsel}" if fsel else ""),
-                                 rv)
+                                 rv, allow_bookmarks=bookmarks)
     if method == "GET":
         return 200, st.get(namespace, name)
     if method == "PUT":

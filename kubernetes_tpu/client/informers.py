@@ -224,25 +224,36 @@ class SharedInformer:
                 dele(tomb)
         self._synced.set()
 
-        w = self.rc.watch(self.namespace, self.label_selector,
-                          self.field_selector, resource_version=rv)
-        self._watch = w
-        try:
-            while not self._stop.is_set():
-                ev = w.next(timeout=1.0)
-                if ev is None:
-                    if w.stopped:
-                        return  # stream ended → relist
-                    continue
-                if ev.type == mwatch.ERROR:
-                    # 410 Gone → relist from scratch (reflector.go relist)
-                    return
-                self._dispatch(ev)
-                self.last_sync_rv = meta.resource_version(ev.object) or \
-                    self.last_sync_rv
-        finally:
-            w.stop()
-            self._watch = None
+        # Watch, RESUMING across clean stream ends: bookmarks keep
+        # last_sync_rv fresh on quiet resources, so a dropped stream
+        # re-watches from there (reflector.go re-establishes the watch
+        # from its lastSyncResourceVersion) — only an ERROR (410 Gone)
+        # forces the full relist this method restarts with.
+        while not self._stop.is_set():
+            w = self.rc.watch(self.namespace, self.label_selector,
+                              self.field_selector,
+                              resource_version=self.last_sync_rv,
+                              allow_bookmarks=True)
+            self._watch = w
+            try:
+                while not self._stop.is_set():
+                    ev = w.next(timeout=1.0)
+                    if ev is None:
+                        if w.stopped:
+                            break  # stream ended → resume from last rv
+                        continue
+                    if ev.type == mwatch.ERROR:
+                        # 410 Gone → relist from scratch (reflector relist)
+                        return
+                    self._dispatch(ev)
+                    self.last_sync_rv = meta.resource_version(ev.object) or \
+                        self.last_sync_rv
+            finally:
+                w.stop()
+                self._watch = None
+            if self._stop.wait(0.05):
+                return  # brief pause: a server that insta-closes streams
+                # must not spin the resume loop hot
 
     def _dispatch(self, ev: mwatch.Event) -> None:
         with self._handler_mu:

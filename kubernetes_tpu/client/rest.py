@@ -232,7 +232,8 @@ class ResourceClient:
         return self.transport.request("DELETE", self._path(namespace), q, None)
 
     def watch(self, namespace: str = "", label_selector: str = "",
-              field_selector: str = "", resource_version: str = "") -> mwatch.Watch:
+              field_selector: str = "", resource_version: str = "",
+              allow_bookmarks: bool = False) -> mwatch.Watch:
         q: Dict[str, str] = {}
         if label_selector:
             q["labelSelector"] = label_selector
@@ -240,6 +241,8 @@ class ResourceClient:
             q["fieldSelector"] = field_selector
         if resource_version:
             q["resourceVersion"] = resource_version
+        if allow_bookmarks:
+            q["allowWatchBookmarks"] = "true"
         return self.transport.stream_watch(self._path(namespace), q)
 
     # -- subresources ------------------------------------------------------- #

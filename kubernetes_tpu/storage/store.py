@@ -13,7 +13,9 @@ Cacher, storage/cacher/cacher.go:309).
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.machinery import errors, meta
@@ -45,9 +47,16 @@ class Storage:
     def __init__(self, kv=None):
         self.kv = kv if kv is not None else native.new_kv()
         self._watch_mu = threading.Lock()
-        # (prefix, watch, predicate, since_rev): events <= since_rev are
-        # before this watcher's horizon and never delivered to it
-        self._watchers: List[Tuple[str, mwatch.Watch, Predicate, int]] = []
+        # (prefix, watch, predicate, since_rev, bookmarks): events <=
+        # since_rev are before this watcher's horizon and never delivered;
+        # `bookmarks` watchers additionally receive periodic BOOKMARK
+        # events carrying the dispatched revision (WatchBookmarks,
+        # cacher.go bookmark timer) so reflectors resume from recent RVs
+        # after quiet disconnects instead of falling into a 410 relist
+        self._watchers: List[Tuple[str, mwatch.Watch, Predicate, int,
+                                   bool]] = []
+        self._bookmark_interval = float(os.environ.get(
+            "KTPU_WATCH_BOOKMARK_INTERVAL", "10"))
         self._dispatched_rev = self.kv.rev()
         # Cacher tier (storage/cacher.py ⇔ cacher.go:309): the pump decodes
         # each event once into this ring; watcher catch-up replays from it so
@@ -62,7 +71,7 @@ class Storage:
         self._stop.set()
         self._pump.join(timeout=2)
         with self._watch_mu:
-            for _, w, _, _ in self._watchers:
+            for _, w, _, _, _ in self._watchers:
                 w.stop()
             self._watchers.clear()
         self.kv.close()
@@ -153,7 +162,8 @@ class Storage:
     # ------------------------------------------------------------------ #
 
     def watch(self, prefix: str, since_rv: str = "",
-              predicate: Predicate = None) -> mwatch.Watch:
+              predicate: Predicate = None,
+              bookmarks: bool = False) -> mwatch.Watch:
         """Watch events under prefix with revision > since_rv.
 
         since_rv ""/"0" = from now. Raises Gone(410) if since_rv predates
@@ -187,7 +197,8 @@ class Storage:
                         break  # the pump will deliver the rest
                     self._send(w, ev, predicate)
             self._watchers.append((prefix, w, predicate,
-                                   max(since, self._dispatched_rev)))
+                                   max(since, self._dispatched_rev),
+                                   bookmarks))
         return w
 
     @staticmethod
@@ -216,9 +227,28 @@ class Storage:
         # event path for everyone else (cacher.go forgetWatcher semantics)
         w.send(mwatch.Event(ce.type, obj), timeout=timeout)
 
+    def _send_bookmarks(self) -> None:
+        with self._watch_mu:
+            for _, w, _, since, bm in self._watchers:
+                if bm and not w.stopped:
+                    # never below the watcher's own horizon: a bookmark at
+                    # the pump's (possibly lagging) revision would hand a
+                    # resuming reflector an RV it has already consumed past,
+                    # replaying duplicates (the cacher's bookmark path
+                    # guarantees the same monotonicity)
+                    rv = max(since, self._dispatched_rev)
+                    w.send(mwatch.Event(mwatch.BOOKMARK, {
+                        "kind": "Bookmark", "apiVersion": "v1",
+                        "metadata": {"resourceVersion": str(rv)}}),
+                        timeout=0)
+
     def _dispatch_loop(self) -> None:
+        last_bm = time.monotonic()
         while not self._stop.is_set():
             rev = self.kv.wait(self._dispatched_rev, timeout=0.25)
+            if time.monotonic() - last_bm >= self._bookmark_interval:
+                last_bm = time.monotonic()
+                self._send_bookmarks()
             if rev <= self._dispatched_rev:
                 continue
             try:
@@ -230,7 +260,7 @@ class Storage:
                 with self._watch_mu:
                     gone = errors.new_gone(
                         "watch events compacted away; relist required")
-                    for _, w, _, _ in self._watchers:
+                    for _, w, _, _, _ in self._watchers:
                         w.send(mwatch.Event(mwatch.ERROR, gone.status()),
                                timeout=0)
                         w.stop()
@@ -248,10 +278,10 @@ class Storage:
                 for ce in cached:
                     self.watch_cache.add(ce)
                 live = []
-                for prefix, w, pred, since in self._watchers:
+                for prefix, w, pred, since, bm in self._watchers:
                     if w.stopped:
                         continue
-                    live.append((prefix, w, pred, since))
+                    live.append((prefix, w, pred, since, bm))
                     for ce in cached:
                         if ce.rev > since and ce.key.startswith(prefix):
                             self._deliver(w, ce, pred)

@@ -199,6 +199,37 @@ class TestStorage:
         assert [e.type for e in evs2] == [mwatch.MODIFIED, mwatch.DELETED]
         w2.stop()
 
+    def test_watch_bookmarks_opt_in(self, monkeypatch):
+        """WatchBookmarks (cacher.go bookmark timer): opted-in watchers get
+        periodic BOOKMARK events carrying the dispatched revision; plain
+        watchers never see them."""
+        monkeypatch.setenv("KTPU_WATCH_BOOKMARK_INTERVAL", "0.3")
+        storage = Storage(kv=native.new_kv(prefer_native=False))
+        try:
+            wb = storage.watch("/registry/pods/", bookmarks=True)
+            plain = storage.watch("/registry/pods/")
+            storage.create("/registry/pods/default/a", _pod("a"), "pods")
+            seen = []
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                ev = wb.next(timeout=0.5)
+                if ev is not None:
+                    seen.append(ev)
+                if any(e.type == mwatch.BOOKMARK for e in seen):
+                    break
+            bms = [e for e in seen if e.type == mwatch.BOOKMARK]
+            assert bms, "no bookmark within 5s at a 0.3s interval"
+            rv = int(bms[0].object["metadata"]["resourceVersion"])
+            assert rv >= 1
+            # the plain watcher got the ADDED event and nothing else
+            ev = plain.next(timeout=2)
+            assert ev.type == mwatch.ADDED
+            assert plain.next(timeout=0.8) is None
+            wb.stop()
+            plain.stop()
+        finally:
+            storage.close()
+
     def test_watch_predicate_filters(self, storage):
         w = storage.watch("/registry/pods/",
                           predicate=lambda o: o["metadata"]["namespace"] == "prod")
