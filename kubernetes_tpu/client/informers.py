@@ -229,6 +229,13 @@ class SharedInformer:
         # re-watches from there (reflector.go re-establishes the watch
         # from its lastSyncResourceVersion) — only an ERROR (410 Gone)
         # forces the full relist this method restarts with.
+        # Silence bound: a healthy opted-in stream carries a bookmark at
+        # least every KTPU_WATCH_BOOKMARK_INTERVAL (10s default); total
+        # silence far beyond that means the watch is deaf (e.g. resumed
+        # from a future RV after a storage reset, where the server happily
+        # streams nothing forever) — relist rather than trust it.
+        silence_limit = 90.0
+        last_signal = time.monotonic()
         while not self._stop.is_set():
             w = self.rc.watch(self.namespace, self.label_selector,
                               self.field_selector,
@@ -241,7 +248,10 @@ class SharedInformer:
                     if ev is None:
                         if w.stopped:
                             break  # stream ended → resume from last rv
+                        if time.monotonic() - last_signal > silence_limit:
+                            return  # deaf watch → full relist
                         continue
+                    last_signal = time.monotonic()
                     if ev.type == mwatch.ERROR:
                         # 410 Gone → relist from scratch (reflector relist)
                         return
@@ -251,6 +261,8 @@ class SharedInformer:
             finally:
                 w.stop()
                 self._watch = None
+            if time.monotonic() - last_signal > silence_limit:
+                return  # repeated silent resumes → full relist
             if self._stop.wait(0.05):
                 return  # brief pause: a server that insta-closes streams
                 # must not spin the resume loop hot

@@ -148,6 +148,17 @@ class HTTPTransport:
                         pump_binary(r)
                     else:
                         pump_json(r)
+            except urllib.error.HTTPError as e:
+                # a refused watch (410 Gone on a compacted resume RV) must
+                # surface as a watch ERROR, not masquerade as a clean
+                # stream end — the reflector's relist path keys on it
+                try:
+                    status = self._decode_body(
+                        e.read(), e.headers.get("Content-Type", ""))
+                except Exception:  # noqa: BLE001
+                    status = {"kind": "Status", "code": e.code,
+                              "reason": "Unknown"}
+                w.send(mwatch.Event(mwatch.ERROR, status))
             except Exception:  # noqa: BLE001 — stream teardown
                 pass
             finally:

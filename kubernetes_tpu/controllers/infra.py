@@ -231,6 +231,12 @@ class NodeLifecycleController(Controller):
         self.clock = clock
         self.node_informer = self.watch_resource("nodes")
         self.pod_informer = self.factory.informer("pods")
+        # kube-node-lease renewals are the cheap heartbeat path; watched,
+        # not polled (the reference's lease informer), and scoped to the
+        # one namespace that matters — an unscoped watch would churn on
+        # every leader-election renewal in kube-system
+        self.lease_informer = self.factory.informer(
+            "leases", namespace="kube-node-lease")
         self._taint_since: Dict[str, float] = {}
 
     def poll_once(self, now: Optional[float] = None) -> None:
@@ -247,10 +253,20 @@ class NodeLifecycleController(Controller):
             self._check_node(node, self.clock())
 
     def _heartbeat(self, node: Dict) -> float:
+        """Freshest signal of kubelet life: the Ready condition's heartbeat
+        OR the node's kube-node-lease renewal, whichever is newer — the
+        lease is the CHEAP heartbeat path (node_lifecycle_controller.go
+        tryUpdateNodeHealth reads both; a kubelet that only renews its
+        lease must not be declared unreachable)."""
         hb = 0.0
         for c in node.get("status", {}).get("conditions", []) or []:
             if c.get("type") == "Ready":
                 hb = max(hb, float(c.get("heartbeatUnix", 0) or 0))
+        lease = self.lease_informer.lister.get("kube-node-lease",
+                                               meta.name(node))
+        if lease is not None:
+            hb = max(hb, float(lease.get("spec", {})
+                               .get("renewTime", 0) or 0))
         return hb
 
     def _check_node(self, node: Dict, now: float) -> None:

@@ -542,6 +542,42 @@ class TestGCAndPodGC:
 
 
 class TestNodeLifecycle:
+    def test_lease_renewal_keeps_node_alive(self, client):
+        """kube-node-lease is the CHEAP heartbeat: a node whose status
+        heartbeat goes stale but whose lease keeps renewing must not be
+        declared unreachable (tryUpdateNodeHealth reads both)."""
+        fake_now = [1000.0]
+        factory = InformerFactory(client)
+        nlc = NodeLifecycleController(client, factory, monitor_grace=30.0,
+                                      clock=lambda: fake_now[0])
+        factory.start()
+        factory.wait_for_sync()
+        client.nodes.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "leasey"},
+            "status": {"conditions": [{"type": "Ready", "status": "True",
+                                       "heartbeatUnix": 1000.0}]}})
+        client.leases.create({
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": "leasey", "namespace": "kube-node-lease"},
+            "spec": {"holderIdentity": "leasey", "renewTime": 1000.0,
+                     "leaseDurationSeconds": 40}}, "kube-node-lease")
+        time.sleep(0.4)
+        # status heartbeat stale, lease fresh → still healthy
+        fake_now[0] = 1050.0
+        lease = client.leases.get("leasey", "kube-node-lease")
+        lease["spec"]["renewTime"] = 1049.0
+        client.leases.update(lease, "kube-node-lease")
+        time.sleep(0.4)
+        nlc.poll_once()
+        assert "taints" not in client.nodes.get("leasey", "").get("spec", {})
+        # lease also goes stale → unreachable
+        fake_now[0] = 1100.0
+        nlc.poll_once()
+        assert any(t["key"] == TAINT_UNREACHABLE for t in
+                   client.nodes.get("leasey", "")["spec"].get("taints", []))
+        factory.stop()
+
     def test_stale_heartbeat_taints_and_evicts(self, client):
         fake_now = [1000.0]
         factory = InformerFactory(client)
