@@ -233,8 +233,13 @@ class SharedInformer:
         # least every KTPU_WATCH_BOOKMARK_INTERVAL (10s default); total
         # silence far beyond that means the watch is deaf (e.g. resumed
         # from a future RV after a storage reset, where the server happily
-        # streams nothing forever) — relist rather than trust it.
-        silence_limit = 90.0
+        # streams nothing forever) — relist rather than trust it. The
+        # bound scales with the configured interval so a slow-bookmark
+        # server doesn't turn every quiet watch into a relist loop.
+        import os as _os
+
+        silence_limit = max(9 * float(_os.environ.get(
+            "KTPU_WATCH_BOOKMARK_INTERVAL", "10") or 10), 90.0)
         last_signal = time.monotonic()
         while not self._stop.is_set():
             w = self.rc.watch(self.namespace, self.label_selector,

@@ -133,6 +133,9 @@ class SchedulerCache:
         self._encoder: Optional[Encoder] = None
         self._reg_sizes: Dict[str, int] = {}
         self._n_topo_keys = 0
+        # pending-batch staging (see _pending_block)
+        self._pending_stage = None
+        self._pending_stage_keys: Optional[Tuple] = None
         # introspection for tests/bench: how the last snapshot was produced
         self.last_snapshot_mode: str = ""   # "cached" | "patch" | "full"
         self.last_patch_rows: int = 0
@@ -569,6 +572,11 @@ class SchedulerCache:
         self._encoder = encoder
         self._reg_sizes = self._registry_sizes(encoder)
         self._n_topo_keys = len(encoder.vocabs.topo_keys)
+        # the pending stage holds rows interned under THIS encoder's
+        # vocabularies; a full re-encode (possibly with a fresh encoder)
+        # makes them unusable for diffing
+        self._pending_stage = None
+        self._pending_stage_keys = None
         self._dirty_nodes.clear()
         self._dirty_pods.clear()
         self.last_patch_rows = len(self._node_names)
@@ -703,12 +711,18 @@ class SchedulerCache:
             rows = PodArrays(*[np.ascontiguousarray(f[idx]) for f in host])
             existing = _patch_rows(existing, jnp.asarray(idx), rows)
 
-        # --- pending ---
+        # --- pending: identity-diffed against the previous batch ---
+        # The unschedulable/backoff queues feed largely the SAME pod
+        # objects cycle after cycle (the reference's queues hold object
+        # references; our encoder memoizes rows by object identity), so
+        # when the batch mostly repeats, only the changed slots are
+        # re-derived on a persistent staging block — the pod-axis analog
+        # of the generation-diffed node snapshot (cache.go:204-255).
         if pending_keys == snap.pending_keys:
             pe = snap.pending
         else:
-            pe = jax.device_put(encoder.build_pod_arrays(
-                list(pending), d, self._node_slot, capacity=d.P))
+            pe = self._pending_block(encoder, pending, pending_keys, d,
+                                     snap.pending)
 
         new_snap = Snapshot(
             generation=gen,
@@ -726,6 +740,78 @@ class SchedulerCache:
         self.last_patch_rows = len(node_idx) + len(pod_idx)
         self._snapshot = new_snap
         return new_snap
+
+
+    def _pending_block(self, encoder, pending, pending_keys, d: Dims,
+                       prev_device):
+        """Pending PodArrays, identity-diffed against the previous batch:
+        when the batch largely repeats, only the changed slots re-derive on
+        the persistent host stage and SCATTER into the resident device
+        arrays — the same `_patch_rows` + bucketed-index pattern the node
+        and existing-pod rows use, so one changed pod costs one small
+        scatter, never a full [P] re-upload. Falls back to the full
+        vectorized assembly when the shape changed or most slots differ
+        (fresh batch churn — the diff would cost more than it saves)."""
+        from .dims import bucket
+
+        prev_keys = self._pending_stage_keys
+        stage = self._pending_stage
+        # nodeName-bearing batches route to the scan engine and carry slot
+        # references that can go stale when node slots churn — they take
+        # the full assembly, not the diff
+        if (stage is not None and prev_keys is not None
+                and not d.has_node_name
+                and stage.valid.shape[0] == d.P
+                and len(prev_keys) == len(pending_keys)):
+            changed = [i for i, (a, b) in enumerate(
+                zip(prev_keys, pending_keys)) if a != b]
+            if len(changed) <= max(len(pending_keys) // 8, 32):
+                for i in changed:
+                    p = pending[i]
+                    stage.rows[i] = encoder.pod_row(p)
+                    stage.node_id[i] = self._node_slot.get(
+                        p.node_name, -1) if p.node_name else -1
+                    stage.valid[i] = True
+                self._pending_stage_keys = pending_keys
+                kb = bucket(len(changed))
+                idx = _pad_patch(changed, kb)
+                rows = PodArrays(
+                    valid=stage.valid[idx],
+                    name_id=np.ascontiguousarray(stage.rows[idx, 0]),
+                    ns=np.ascontiguousarray(stage.rows[idx, 1]),
+                    cls=np.ascontiguousarray(stage.rows[idx, 2]),
+                    priority=np.ascontiguousarray(stage.rows[idx, 3]),
+                    creation=np.ascontiguousarray(stage.rows[idx, 4]),
+                    node_id=stage.node_id[idx],
+                    node_name_req=np.ascontiguousarray(stage.rows[idx, 5]),
+                )
+                return _patch_rows(prev_device, jnp.asarray(idx), rows)
+        pe_host = encoder.build_pod_arrays(
+            list(pending), d, self._node_slot, capacity=d.P)
+        self._pending_stage = _PendingStage.from_pod_arrays(pe_host)
+        self._pending_stage_keys = pending_keys
+        return jax.device_put(pe_host)
+
+
+class _PendingStage:
+    """Persistent host staging for the pending batch ([P, 6] rows +
+    node_id + valid), patched in place across cycles."""
+
+    __slots__ = ("rows", "node_id", "valid")
+
+    def __init__(self, rows, node_id, valid):
+        self.rows = rows
+        self.node_id = node_id
+        self.valid = valid
+
+    @classmethod
+    def from_pod_arrays(cls, pe: PodArrays) -> "_PendingStage":
+        rows = np.stack([pe.name_id, pe.ns, pe.cls, pe.priority,
+                         pe.creation, pe.node_name_req], axis=1)
+        return cls(rows=np.ascontiguousarray(rows),
+                   node_id=np.array(pe.node_id, copy=True),
+                   valid=np.array(pe.valid, copy=True))
+
 
 
 class FakeCache(SchedulerCache):
