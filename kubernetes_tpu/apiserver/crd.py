@@ -78,8 +78,29 @@ class ConversionEntry:
             raise errors.StatusError(
                 500, "InternalError",
                 "conversion webhook returned the wrong object count")
-        for c in conv:
+        for src, c in zip(objs, conv):
             c["apiVersion"] = apiv
+            # conversion must preserve object identity (the reference's
+            # webhook converter validates this — a converter that mutates
+            # name/namespace/uid/resourceVersion corrupts identity on
+            # GET/LIST/WATCH and on bodies converted to storage version)
+            src_meta = src.get("metadata", {}) or {}
+            c_meta = c.setdefault("metadata", {})
+            for field in ("name", "namespace", "uid", "resourceVersion"):
+                if field not in src_meta:
+                    continue
+                if field not in c_meta:
+                    # a converter that DROPS an identity field is sloppy,
+                    # not conflicting: restore it (a served object without
+                    # resourceVersion would defeat optimistic concurrency
+                    # on the client's next full-object PUT)
+                    c_meta[field] = src_meta[field]
+                elif c_meta[field] != src_meta[field]:
+                    raise errors.StatusError(
+                        500, "InternalError",
+                        f"conversion webhook for {self.group}/{self.plural}"
+                        f" mutated metadata.{field} of "
+                        f"{src_meta.get('name', '?')}")
         return conv
 
 

@@ -56,6 +56,12 @@ class IPAllocator:
                 if addr not in self.net:
                     raise AllocationError(
                         f"{ip} is not in the service CIDR {self.net}")
+                if not self._first <= int(addr) <= self._last:
+                    # network/broadcast/VIP: auto-allocation skips these,
+                    # so explicit requests must be rejected too (the
+                    # reference's bitmap treats them as out of range)
+                    raise AllocationError(
+                        f"{ip} is a reserved address in {self.net}")
                 if int(addr) in self._used:
                     raise AllocationError(
                         "provided IP is already allocated")
@@ -132,20 +138,34 @@ def _release(api, svc: Obj) -> None:
     for p in spec.get("ports", []) or []:
         if p.get("nodePort"):
             api._svc_port_alloc.release(int(p["nodePort"]))
+    # drop any stranded pending-release stash (e.g. a rejected update)
+    api._svc_pending_release.pop(
+        f"{meta.namespace(svc)}/{meta.name(svc)}", None)
+
+
+def _release_pending(api, svc: Obj) -> None:
+    """after_update hook: the write COMMITTED — release the node ports the
+    admitted transition dropped (stashed by _allocate_into)."""
+    key = f"{meta.namespace(svc)}/{meta.name(svc)}"
+    for port in api._svc_pending_release.pop(key, ()):
+        api._svc_port_alloc.release(port)
 
 
 def _allocators(api):
     if not hasattr(api, "_svc_ip_alloc"):
         api._svc_ip_alloc = IPAllocator()
         api._svc_port_alloc = PortAllocator()
+        api._svc_pending_release = {}
         # release rides the store's after_delete hook, which fires when the
         # object actually LEAVES storage — both on immediate deletes and
         # when the last finalizer clears (registry.py
         # _finish_delete_if_ready). Releasing at DELETE admission would
         # free the address while a finalizer-bearing Service still exists.
+        # Same post-commit principle for UPDATE-dropped ports: after_update.
         try:
-            api.store("", "services").after_delete = \
-                lambda svc: _release(api, svc)
+            store = api.store("", "services")
+            store.after_delete = lambda svc: _release(api, svc)
+            store.after_update = lambda svc: _release_pending(api, svc)
         except errors.StatusError:
             pass
         repair(api)
@@ -230,9 +250,19 @@ class ServiceAllocatorPlugin:
                 raise errors.new_invalid(
                     "services", meta.name(svc),
                     f"spec.clusterIP: Invalid value: {ip!r}: {e}")
-        old_ports = {id(p): p for p in old_spec.get("ports", []) or []}
         held = {int(p.get("nodePort")) for p in old_spec.get("ports", [])
                 or [] if p.get("nodePort")}
+        # intra-object duplicates are a validation error, not an allocator
+        # question (the reference rejects them in service validation before
+        # allocation; letting the second hit the allocator would trip the
+        # repair sweep into freeing the first)
+        requested = [int(p.get("nodePort", 0) or 0)
+                     for p in spec.get("ports", []) or []]
+        dups = {x for x in requested if x and requested.count(x) > 1}
+        if dups and _wants_node_ports(svc):
+            raise errors.new_invalid(
+                "services", meta.name(svc),
+                f"spec.ports.nodePort: Duplicate value: {sorted(dups)[0]}")
         if _wants_node_ports(svc):
             for p in spec.get("ports", []) or []:
                 want = int(p.get("nodePort", 0) or 0)
@@ -249,7 +279,20 @@ class ServiceAllocatorPlugin:
                     raise errors.new_invalid(
                         "services", meta.name(svc),
                         f"spec.ports.nodePort: Invalid value: {want}: {e}")
-        _ = old_ports  # documentational: carried ports identified via `held`
+        if old is not None:
+            # UPDATE: held ports the new spec no longer claims (dropped from
+            # spec.ports, or the type stopped wanting node ports entirely,
+            # NodePort→ClusterIP) release AFTER the write commits — the
+            # after_update hook pops this stash. Releasing here would free
+            # live ports when validation (which runs after admission,
+            # registry.py) or the CAS rejects the update. A concurrent-
+            # update race can strand a stash entry (never popped): that
+            # leak heals via the lazy repair sweep, same as failed creates.
+            keep = ({int(p.get("nodePort", 0) or 0)
+                     for p in spec.get("ports", []) or []}
+                    if _wants_node_ports(svc) else set())
+            api._svc_pending_release[
+                f"{meta.namespace(svc)}/{meta.name(svc)}"] = held - keep
 
     @staticmethod
     def _with_specific_repair(api, alloc):

@@ -454,6 +454,15 @@ class DaemonSetController(Controller):
         # the node's daemon turns Ready; pruned with its DaemonSet.
         self._failed_backoff: Dict[tuple, tuple] = {}  # (key,node)→(n,next)
         self._counted_failures: set = set()            # pod uids
+        # node changes re-sync every daemonset — registered ONCE here:
+        # registering in poll_once would append a fresh handler triple to
+        # the shared node informer every tick (unbounded growth, O(nodes)
+        # synthetic on_add replays per tick)
+        self.node_informer = self.factory.informer("nodes")
+        self.node_informer.add_handlers(
+            on_add=lambda o: self._enqueue_all(),
+            on_update=lambda o, n: self._enqueue_all(),
+            on_delete=lambda o: self._enqueue_all())
 
     def poll_once(self, now=None) -> None:
         """Backoff-expiry retries: nothing re-enqueues a DaemonSet when a
@@ -463,12 +472,6 @@ class DaemonSetController(Controller):
         for ds in self.ds_informer.lister.list():
             if meta.namespaced_key(ds) in pending:
                 self.enqueue(ds)
-        # node changes re-sync every daemonset
-        self.node_informer = self.factory.informer("nodes")
-        self.node_informer.add_handlers(
-            on_add=lambda o: self._enqueue_all(),
-            on_update=lambda o, n: self._enqueue_all(),
-            on_delete=lambda o: self._enqueue_all())
 
     def _enqueue_all(self) -> None:
         for ds in self.ds_informer.lister.list():

@@ -362,8 +362,14 @@ class Kubelet:
                 self.client.pods.update_status(cur, meta.namespace(pod))
                 return True
             except errors.StatusError as e:
-                if not errors.is_conflict(e):
+                if errors.is_not_found(e):
                     return True  # gone from the API — nothing left to mark
+                if not errors.is_conflict(e):
+                    # transient server error (500, auth, ...): park and let
+                    # housekeeping retry — only NotFound means done
+                    return False
+            except Exception:  # noqa: BLE001 - transport error: park, retry
+                return False
         return False
 
     # ------------------------------------------------------------------ #

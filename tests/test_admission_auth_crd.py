@@ -465,3 +465,46 @@ class TestQuotaConcurrency:
         pods, _ = api.store("", "pods").storage.list(
             api.store("", "pods").prefix_for("default"))
         assert len(pods) == 3
+
+
+class TestConversionIdentity:
+    """ADVICE r4 (low): a conversion webhook that mutates identity metadata
+    (name/namespace/uid/resourceVersion) must be rejected with a 500, not
+    trusted wholesale (the reference's webhook converter validates this)."""
+
+    def test_identity_mutation_rejected(self, api):
+        from kubernetes_tpu.apiserver.webhooks import (
+            register_local_webhook, unregister_local_webhook,
+        )
+
+        def evil_converter(review):
+            req = review["request"]
+            out = []
+            for o in req["objects"]:
+                o = meta.deep_copy(o)
+                o["metadata"]["name"] = "hijacked"
+                out.append(o)
+            return {"response": {"uid": req["uid"],
+                                 "result": {"status": "Success"},
+                                 "convertedObjects": out}}
+
+        crd = meta.deep_copy(TestCRD.MULTIVER_CRD)
+        crd["metadata"]["name"] = "boxes.shop.example.com"
+        crd["spec"]["names"] = {"plural": "boxes", "kind": "Box"}
+        crd["spec"]["conversion"]["webhook"]["clientConfig"]["url"] = \
+            "local://evil-converter"
+        register_local_webhook("local://evil-converter", evil_converter)
+        try:
+            client = Client.local(api)
+            client.customresourcedefinitions.create(crd)
+            b1 = client.resource("shop.example.com", "v1", "boxes", True)
+            b2 = client.resource("shop.example.com", "v2", "boxes", True)
+            b1.create({"apiVersion": "shop.example.com/v1", "kind": "Box",
+                       "metadata": {"name": "a", "namespace": "default"},
+                       "spec": {}})
+            with pytest.raises(errors.StatusError) as ei:
+                b2.get("a")
+            assert ei.value.code == 500
+            assert "metadata.name" in ei.value.message
+        finally:
+            unregister_local_webhook("local://evil-converter")

@@ -802,3 +802,21 @@ class TestNodeIpam:
         assert wait_for(all_assigned)
         cidr = client.nodes.get("n0")["spec"]["podCIDR"]
         assert cidr.startswith("10.244.") and cidr.endswith("/24")
+
+
+class TestDaemonSetInformerRegistration:
+    def test_node_handlers_registered_once(self, client):
+        """ADVICE r4 (high): poll_once must NOT re-register node-informer
+        handlers — the handler list would grow per tick, each registration
+        replaying on_add for every node."""
+        from kubernetes_tpu.client import InformerFactory
+        from kubernetes_tpu.controllers.workloads import DaemonSetController
+
+        factory = InformerFactory(client)
+        ctl = DaemonSetController(client, factory)
+        node_inf = factory.informer("nodes")
+        before = len(node_inf._handlers)
+        for _ in range(5):
+            ctl.poll_once()
+        assert len(node_inf._handlers) == before
+        assert ctl.node_informer is node_inf  # usable before any poll tick

@@ -333,3 +333,51 @@ class TestKubeletCheckpoint:
             cm.get_checkpoint("pod-abc")
         cm.remove_checkpoint("pod-abc")
         assert cm.get_checkpoint("pod-abc") is None
+
+
+class TestEvictedStatusWriteRetry:
+    def test_transient_error_parks_not_forgets(self):
+        """ADVICE r4 (medium): only NotFound means 'nothing left to mark' —
+        a transient 500 or a transport error must return False so
+        housekeeping keeps retrying the Evicted status write."""
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.client import Client
+        from kubernetes_tpu.kubelet import FakeCRI, Kubelet
+        from kubernetes_tpu.machinery import errors
+
+        api = APIServer()
+        client = Client.local(api)
+        kubelet = Kubelet(client, "n1", cri=FakeCRI())
+        try:
+            pod = client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "victim", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "i"}]}})
+
+            def boom(obj, ns=""):
+                raise errors.StatusError(500, "InternalError", "hiccup")
+
+            orig = client.pods.update_status
+            client.pods.update_status = boom
+            assert kubelet._write_evicted_status(pod) is False
+
+            def crash(obj, ns=""):
+                raise OSError("connection reset")
+
+            client.pods.update_status = crash
+            assert kubelet._write_evicted_status(pod) is False
+
+            client.pods.update_status = orig
+            assert kubelet._write_evicted_status(pod) is True
+            assert client.pods.get("victim")["status"]["reason"] == "Evicted"
+
+            client.pods.delete("victim", "default")
+            try:
+                client.pods.get("victim")
+                gone = False
+            except errors.StatusError:
+                gone = True
+            if gone:  # NotFound IS success — the pod no longer exists
+                assert kubelet._write_evicted_status(pod) is True
+        finally:
+            api.close()

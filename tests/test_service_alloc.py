@@ -121,3 +121,75 @@ class TestNodePortAllocation:
         updated = client.services.update(cur, "default")
         ports = [p.get("nodePort") for p in updated["spec"]["ports"]]
         assert ports[0] == first and ports[1] and ports[1] != first
+
+
+class TestAdvisorFindings:
+    """ADVICE r4: UPDATE-path releases, duplicate nodePorts, reserved IPs."""
+
+    def test_update_releases_dropped_node_port(self, api, client):
+        client.services.create(svc(
+            "shrink", type="NodePort",
+            ports=[{"port": 80, "nodePort": 30101},
+                   {"port": 443, "nodePort": 30102}]))
+        cur = client.services.get("shrink")
+        cur["spec"]["ports"] = [{"port": 80, "nodePort": 30101}]
+        client.services.update(cur, "default")
+        # 30102 must be free again WITHOUT a repair sweep
+        assert 30102 not in api._svc_port_alloc._used
+        client.services.create(svc("reuse", type="NodePort",
+                                   ports=[{"port": 80, "nodePort": 30102}]))
+
+    def test_type_change_releases_all_node_ports(self, api, client):
+        client.services.create(svc("flip", type="NodePort",
+                                   ports=[{"port": 80, "nodePort": 30111}]))
+        cur = client.services.get("flip")
+        cur["spec"]["type"] = "ClusterIP"
+        cur["spec"]["ports"] = [{"port": 80}]
+        client.services.update(cur, "default")
+        assert 30111 not in api._svc_port_alloc._used
+
+    def test_duplicate_node_ports_rejected(self, client):
+        import pytest as _pytest
+
+        from kubernetes_tpu.machinery import errors as _errors
+        with _pytest.raises(_errors.StatusError) as ei:
+            client.services.create(svc(
+                "dup", type="NodePort",
+                ports=[{"port": 80, "nodePort": 30121},
+                       {"port": 443, "nodePort": 30121}]))
+        assert ei.value.code == 422
+        assert "Duplicate" in ei.value.message
+        # the failed create must not leak the port
+        client.services.create(svc("after", type="NodePort",
+                                   ports=[{"port": 80, "nodePort": 30121}]))
+
+    def test_reserved_addresses_rejected_explicitly(self, client):
+        import pytest as _pytest
+
+        from kubernetes_tpu.machinery import errors as _errors
+        for bad in ("10.96.0.0",      # network address
+                    "10.96.255.255",  # broadcast
+                    "10.96.0.1"):     # first address (VIP)
+            with _pytest.raises(_errors.StatusError) as ei:
+                client.services.create(svc(f"r{bad.split('.')[-1]}",
+                                           clusterIP=bad))
+            assert ei.value.code == 422, bad
+
+    def test_rejected_update_does_not_release(self, api, client):
+        """Release must be post-commit: an update that fails validation
+        (after admission) must leave the live Service's ports allocated."""
+        client.services.create(svc("hold", type="NodePort",
+                                   ports=[{"port": 80, "nodePort": 30131}]))
+        cur = client.services.get("hold")
+        cur["spec"]["ports"] = []  # invalid: ports required
+        import pytest as _pytest
+
+        from kubernetes_tpu.machinery import errors as _errors
+        with _pytest.raises(_errors.StatusError):
+            client.services.update(cur, "default")
+        assert 30131 in api._svc_port_alloc._used
+        # and a create claiming the port still conflicts
+        with _pytest.raises(_errors.StatusError):
+            client.services.create(svc("thief", type="NodePort",
+                                       ports=[{"port": 80,
+                                               "nodePort": 30131}]))
