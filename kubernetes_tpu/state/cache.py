@@ -370,15 +370,27 @@ class SchedulerCache:
 
             for s in extra_intern:
                 encoder.vocabs.label_keys.intern(s)
-            for p in pending:
-                encoder.pod_row(p)  # memoized: O(new pods), registers classes
-            if self._staging_nodes is None or self._encoder is not encoder:
-                for st in self._pods.values():   # cold: walk everything once
-                    encoder.pod_row(st.pod)
-            else:
-                for p in self._dirty_pods.values():
-                    if p is not None:
-                        encoder.pod_row(p)       # steady state: O(changed)
+            projection_widened = False
+            for _walk_pass in range(8):  # referenced keys grow monotonically
+                for p in pending:
+                    encoder.pod_row(p)  # memoized: O(new), registers classes
+                if (self._staging_nodes is None
+                        or self._encoder is not encoder
+                        or projection_widened):
+                    for st in self._pods.values():  # cold: walk everything
+                        encoder.pod_row(st.pod)
+                else:
+                    for p in self._dirty_pods.values():
+                        if p is not None:
+                            encoder.pod_row(p)   # steady state: O(changed)
+                if not encoder.classes_stale:
+                    break
+                # a selector referenced a new pod-label key mid-walk:
+                # projected class identities (encode.py class_id) changed
+                # for every pod — drop memos, re-walk ALL pods, and force
+                # the full snapshot path (staged rows hold old class ids)
+                encoder.projection_rewalk()
+                projection_widened = True
             for name in self._dirty_nodes:
                 n = self._nodes.get(name)
                 if n is not None:
@@ -447,6 +459,7 @@ class SchedulerCache:
                 snap is None
                 or self._staging_nodes is None
                 or self._encoder is not encoder
+                or projection_widened
                 or replace(d, has_node_name=False)
                 != replace(snap.dims, has_node_name=False)
             )

@@ -90,6 +90,21 @@ class Encoder:
         self.term_reg = Vocab()      # (sel req tuple, ns_id tuple, topo_key_id)
         self.class_reg = Vocab()     # the full pod-spec tuple
         self._class_spec: List[tuple] = []  # parallel to class_reg ids
+        # Label projection (the TPU-first class-collapse move): a pod's
+        # labels enter its CLASS identity only through the keys some
+        # selector in the system actually matches pod labels by (term_id's
+        # requirement keys — pod affinity/anti-affinity, topology spread,
+        # SelectorSpread owner selectors). Unreferenced labels cannot
+        # change any engine decision, so folding them out merges e.g.
+        # thousands of `app: job-N`-labeled gang jobs with identical
+        # requests into ONE scheduling class — the wave fixpoint then
+        # scales with *distinguishable* specs, not raw label diversity
+        # (BASELINE config 5 goes from ~P/30 classes to ~#tiers).
+        # When a never-before-seen key becomes referenced, every projected
+        # class is potentially split: `classes_stale` tells the cache to
+        # clear row memos and re-walk (SchedulerCache.snapshot).
+        self.referenced_label_keys: set = set()   # label-key vocab ids
+        self.classes_stale = False
         # incremental-encode state (the cache.go:204-255 analog's host half):
         # per-object memos so steady-state cycles do O(changed) interning work.
         self._pod_rows: Dict[int, tuple] = {}   # id(pod) → (pod, row tuple)
@@ -207,6 +222,11 @@ class Encoder:
         reqs = []
         for r in selector.requirements:
             kid = self.vocabs.label_keys.intern(r.key)
+            if kid not in self.referenced_label_keys:
+                # a new pod-label key is now selector-visible: projected
+                # class identities must be recomputed (see __init__ note)
+                self.referenced_label_keys.add(kid)
+                self.classes_stale = True
             vids = tuple(sorted(self.vocabs.label_vals.intern(v) for v in r.values))
             reqs.append((kid, int(r.op), vids))
         ns_ids = tuple(sorted(self.vocabs.namespaces.intern(n) for n in namespaces))
@@ -249,10 +269,23 @@ class Encoder:
             for v in vols))
         return self.volset_reg.intern(key)
 
+    def projection_rewalk(self) -> None:
+        """A new label key became selector-referenced: drop the row memos so
+        the owner re-walks every pod under the widened projection."""
+        self.classes_stale = False
+        self._pod_rows.clear()
+
+    def _projected_labels(self, labels: Dict[str, str]) -> Dict[str, str]:
+        if not labels:
+            return labels
+        ref = self.referenced_label_keys
+        get = self.vocabs.label_keys.get
+        return {k: v for k, v in labels.items() if get(k) in ref}
+
     def class_id(self, p: Pod) -> int:
         ns_id = self.vocabs.namespaces.intern(p.namespace)
         rid = self.req_id(p.requests)
-        ls = self.labelset_id(p.labels)
+        ls = self.labelset_id(self._projected_labels(p.labels))
         nsel = self.nterm_id(nsel_as_term(p.node_selector)) if p.node_selector else -1
         aff_active = p.affinity.node_required is not None
         nterms = tuple(
@@ -861,8 +894,15 @@ class Encoder:
         all tables. Returns (tables, existing_pods, pending_pods, dims)."""
         for n in nodes:
             self.intern_node(n)
-        for p in list(existing) + list(pending):
-            self.pod_row(p)
+        for _walk_pass in range(8):  # referenced keys grow monotonically
+            for p in list(existing) + list(pending):
+                self.pod_row(p)
+            if not self.classes_stale:
+                break
+            # a selector referenced a new pod-label key mid-walk: class
+            # projections changed — re-walk under the widened projection
+            # (the cache path does the same in SchedulerCache.snapshot)
+            self.projection_rewalk()
         d = self.dims(len(nodes), len(existing), len(pending), nodes, base)
         node_index = {n.name: i for i, n in enumerate(nodes)}
         tables = ClusterTables(

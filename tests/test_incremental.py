@@ -301,3 +301,60 @@ def test_randomized_churn_replay_matches_oracle(seed):
             cache.add_node(mknode(f"a{k}", zone=f"z{k % 4}"))
         got = schedule_names(cache, enc, pending)
         assert got == oracle_names(cache, pending), f"divergence at step {step}"
+
+
+class TestLabelProjection:
+    """Class identity projects pod labels onto selector-REFERENCED keys only
+    (encode.py class_id): unreferenced labels cannot change any engine
+    decision, so label-diverse-but-spec-identical pods share one class —
+    the class-collapse that makes BASELINE config 5 tractable — while a key
+    becoming referenced later forces a projection re-walk."""
+
+    def test_unreferenced_labels_collapse_classes(self):
+        enc = Encoder()
+        pods = [Pod(name=f"p{i}", labels={"app": f"job-{i}"},
+                    requests=Resources.make(cpu="1", memory="1Gi"),
+                    creation_index=i) for i in range(100)]
+        for p in pods:
+            enc.pod_row(p)
+        assert len(enc.class_reg) == 1
+        assert not enc.classes_stale
+
+    def test_late_referenced_key_splits_and_still_matches(self):
+        """An affinity pod arriving AFTER label-diverse pods were interned
+        must still match them correctly: the cache re-walks under the
+        widened projection (full snapshot), and placement respects the
+        affinity."""
+        cache = SchedulerCache()
+        enc = Encoder()
+        for z, name in (("z0", "n0"), ("z1", "n1")):
+            cache.add_node(mknode(name, zone=z))
+        # two label-diverse bound pods, no selectors anywhere yet
+        for i, (node, app) in enumerate((("n0", "red"), ("n1", "blue"))):
+            cache.add_pod(Pod(name=f"b{i}", labels={"color": app},
+                              requests=Resources.make(cpu="100m",
+                                                      memory="128Mi"),
+                              node_name=node, creation_index=i))
+        snap1, keys1 = snapshot_with_keys(cache, enc, [], None)
+        assert cache.last_snapshot_mode == "full"
+        # both bound pods share one class: "color" is unreferenced
+        assert len({int(x) for x in np.asarray(
+            jax.device_get(snap1.existing.cls))[:2]}) == 1
+
+        # now a pending pod REQUIRES zone affinity to color=red
+        want_red = Pod(
+            name="seeker", labels={},
+            requests=Resources.make(cpu="100m", memory="128Mi"),
+            affinity=Affinity(pod_required=(PodAffinityTerm(
+                selector=LabelSelector.of(match_labels={"color": "red"}),
+                topology_key=ZONE),)),
+            creation_index=10)
+        snap2, keys2 = snapshot_with_keys(cache, enc, [want_red], None)
+        # the projection widened: full re-walk, classes split
+        assert cache.last_snapshot_mode == "full"
+        assert len({int(x) for x in np.asarray(
+            jax.device_get(snap2.existing.cls))[:2]}) == 2
+        res = _schedule_batch(snap2.tables, snap2.pending, keys2,
+                              snap2.dims.D, snap2.existing)
+        node_idx = int(np.asarray(jax.device_get(res.node))[0])
+        assert snap2.node_order[node_idx] == "n0"  # the red pod's zone
