@@ -211,6 +211,7 @@ def resource_info_from_crd(crd: Obj) -> Optional[ResourceInfo]:
             if (served.get("subresources") or spec.get("subresources") or {})
             .get(s) is not None),
         validator=validator,
+        custom=True,
     )
 
 

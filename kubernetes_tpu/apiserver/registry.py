@@ -210,14 +210,34 @@ class Store:
         return self._finish_delete_if_ready(namespace, name, out)
 
     def patch(self, namespace: str, name: str, patch: Obj,
-              subresource: str = "") -> Obj:
-        """JSON merge patch (RFC 7386) — the reference also serves strategic
-        merge; merge covers the controller/CLI flows we host."""
+              subresource: str = "", patch_type: str = "merge") -> Obj:
+        """PATCH with the three content types the reference serves
+        (apiserver/pkg/endpoints/handlers/patch.go): RFC 7386 JSON merge
+        ("merge"), strategic merge ("strategic" —
+        apimachinery/pkg/util/strategicpatch), RFC 6902 op list ("json")."""
+
+        if patch_type == "strategic" and self.info.custom:
+            # custom resources have no patchStrategy struct tags; the
+            # reference's CR handler rejects SMP with 415 (patch.go,
+            # apiextensions customresource_handler.go)
+            raise errors.StatusError(
+                415, "UnsupportedMediaType",
+                "strategic merge patch is not supported for custom "
+                "resources")
 
         def apply(cur: Obj) -> Obj:
             if not cur:
                 raise errors.new_not_found(self.info.resource, name)
-            new = _merge_patch(cur, patch)
+            if patch_type == "strategic":
+                from kubernetes_tpu.machinery.strategicpatch import (
+                    strategic_merge)
+                new = strategic_merge(cur, patch)
+            elif patch_type == "json":
+                from kubernetes_tpu.machinery.strategicpatch import (
+                    json_patch)
+                new = json_patch(cur, patch)  # type: ignore[arg-type]
+            else:
+                new = _merge_patch(cur, patch)
             nm = meta.ensure_meta(new)
             cm = cur.get("metadata", {})
             for f in ("uid", "creationTimestamp", "namespace", "name",

@@ -402,7 +402,16 @@ def handle_rest(api: APIServer, method: str, path: str,
             if method in _AUDIT_VERBS:
                 _audit(api, method, path, e.code, user, meta.name(body))
             raise
-    out = _handle_rest_audited(api, method, path, query, body, user)
+    if entry is not None and method == "PATCH" and want != entry.storage:
+        # PATCH bodies are partial documents: they cannot convert wholesale.
+        # The reference applies the patch AT THE REQUEST VERSION
+        # (apiserver patch.go → conversion stack): read storage object,
+        # convert to the request version, apply the dialect there, convert
+        # the merged result back, CAS-write (PARITY #16 closed).
+        out = _patch_through_conversion(api, entry, want, path,
+                                        query, body, user)
+    else:
+        out = _handle_rest_audited(api, method, path, query, body, user)
     if entry is None:
         return out
     tag, obj = out
@@ -416,6 +425,58 @@ def handle_rest(api: APIServer, method: str, path: str,
         elif obj.get("kind") != "Status" and "metadata" in obj:
             obj = entry.convert([obj], want)[0]
     return tag, obj
+
+
+def _patch_through_conversion(api: APIServer, entry, want: str,
+                              path: str, query: Dict[str, str],
+                              body, user: str):
+    """Apply a CR patch at the REQUEST version when it differs from the
+    storage version: GET (storage) → convert → merge/json-patch → convert
+    back → CAS PUT, retried on conflict. Strategic merge is rejected for
+    CRs (no struct tags), same as the reference."""
+    from kubernetes_tpu.machinery.strategicpatch import json_patch
+
+    ptype = query.get("__patchType", "merge")
+    if ptype == "strategic":
+        raise errors.StatusError(
+            415, "UnsupportedMediaType",
+            "strategic merge patch is not supported for custom resources")
+    from kubernetes_tpu.apiserver.registry import _merge_patch
+
+    def run():
+        # the internal GET/PUT legs use the UNaudited router: the client
+        # issued ONE patch, so the trail must show one patch — not a fan
+        # of internal update events (one per CAS retry)
+        last: Optional[errors.StatusError] = None
+        for _ in range(5):
+            _, cur = _handle_rest_inner(api, "GET", path, {}, None)
+            cur_req = entry.convert([cur], want)[0]
+            if ptype == "json":
+                new_req = json_patch(cur_req, body)
+            else:
+                new_req = _merge_patch(cur_req, body or {})
+            new_storage = entry.convert([new_req], entry.storage)[0]
+            # CAS on the version we read — a racing write re-runs the patch
+            meta.ensure_meta(new_storage)["resourceVersion"] = \
+                meta.resource_version(cur)
+            try:
+                return _handle_rest_inner(api, "PUT", path, query,
+                                          new_storage)
+            except errors.StatusError as e:
+                if not errors.is_conflict(e):
+                    raise
+                last = e
+        raise last if last is not None else errors.StatusError(
+            500, "InternalError", "patch retry limit")
+
+    try:
+        out = run()
+    except errors.StatusError as e:
+        _audit(api, "PATCH", path, e.code, user)
+        raise
+    _audit(api, "PATCH", path, out[0] if isinstance(out[0], int) else 200,
+           user)
+    return out
 
 
 def _handle_rest_audited(api: APIServer, method: str, path: str,
@@ -558,7 +619,9 @@ def _handle_rest_inner(api: APIServer, method: str, path: str,
                                       subresource="status")
             if method == "PATCH":
                 return 200, st.patch(namespace, name, body or {},
-                                     subresource="status")
+                                     subresource="status",
+                                     patch_type=query.get("__patchType",
+                                                          "merge"))
         raise errors.new_method_not_supported(f"{resource}/{sub}", method)
 
     if watching:
@@ -570,7 +633,8 @@ def _handle_rest_inner(api: APIServer, method: str, path: str,
     if method == "PUT":
         return 200, st.update(namespace, name, body or {})
     if method == "PATCH":
-        return 200, st.patch(namespace, name, body or {})
+        return 200, st.patch(namespace, name, body or {},
+                             patch_type=query.get("__patchType", "merge"))
     if method == "DELETE":
         if info.resource == "namespaces":
             return 200, api.delete_namespace(name)
@@ -615,6 +679,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, errors.new_bad_request(
                     "invalid request body").status())
                 return
+            if method == "PATCH":
+                # patch dialect rides Content-Type
+                # (apiserver/pkg/endpoints/handlers/patch.go patchTypes)
+                query["__patchType"] = {
+                    "application/strategic-merge-patch+json": "strategic",
+                    "application/json-patch+json": "json",
+                }.get(ctype, "merge")
         try:
             user = ""
             try:

@@ -233,21 +233,55 @@ class Kubectl:
         return 0
 
     def apply(self, filename: str, namespace: str = "default") -> int:
-        """Create-or-patch (the essential server-side apply semantics)."""
+        """Three-way apply (apply.go): the patch body is the full desired
+        state PLUS deletions computed against the live object's
+        last-applied-configuration annotation — removing a container/env
+        entry (or a map key) from the manifest removes it from the live
+        object, while fields set by controllers stay. Built-ins patch with
+        strategic merge; custom resources fall back to 3-way JSON merge
+        (lists replace wholesale) when the server answers 415."""
+        import json as _json
+
+        from kubernetes_tpu.machinery.strategicpatch import (
+            LAST_APPLIED_ANNOTATION, apply_patch_body)
+
         for obj in self._load_manifests(filename):
             rc = self._rc_for_obj(obj)
             ns = meta.namespace(obj) or namespace
+            desired = {k: v for k, v in meta.deep_copy(obj).items()
+                       if k != "status"}
+            record = _json.dumps(desired, sort_keys=True,
+                                 separators=(",", ":"))
+            meta.ensure_meta(desired).setdefault("annotations", {})[
+                LAST_APPLIED_ANNOTATION] = record
             try:
-                rc.get(meta.name(obj), ns if rc.namespaced else "")
-                rc.patch(meta.name(obj),
-                         {k: v for k, v in obj.items() if k != "status"},
-                         ns if rc.namespaced else "")
+                live = rc.get(meta.name(obj), ns if rc.namespaced else "")
+                try:
+                    last = _json.loads(
+                        (live.get("metadata", {}).get("annotations") or {})
+                        .get(LAST_APPLIED_ANNOTATION, "") or "{}")
+                except _json.JSONDecodeError:
+                    last = {}
+                body = apply_patch_body(last if isinstance(last, dict)
+                                        else {}, desired)
+                try:
+                    rc.patch(meta.name(obj), body,
+                             ns if rc.namespaced else "",
+                             patch_type="strategic")
+                except errors.StatusError as e:
+                    if e.code != 415:
+                        raise
+                    body = apply_patch_body(
+                        last if isinstance(last, dict) else {},
+                        desired, merge_lists=False)
+                    rc.patch(meta.name(obj), body,
+                             ns if rc.namespaced else "")
                 self.out.write(f"{obj.get('kind', '').lower()}/"
                                f"{meta.name(obj)} configured\n")
             except errors.StatusError as e:
                 if not errors.is_not_found(e):
                     raise
-                rc.create(obj, ns if rc.namespaced else "")
+                rc.create(desired, ns if rc.namespaced else "")
                 self.out.write(f"{obj.get('kind', '').lower()}/"
                                f"{meta.name(obj)} created\n")
         return 0

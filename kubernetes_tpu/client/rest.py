@@ -78,6 +78,11 @@ class HTTPTransport:
                 body: Optional[Obj]) -> Obj:
         from kubernetes_tpu.machinery import codec
 
+        # the patch dialect travels as a Content-Type on the wire (the
+        # gateway maps it back; apiserver patch.go patchTypes) — pop the
+        # local-transport query key and translate
+        query = dict(query)
+        ptype = query.pop("__patchType", None)
         req = urllib.request.Request(self._url(path, query), method=method)
         data = None
         if self.token:
@@ -85,12 +90,20 @@ class HTTPTransport:
         if self.binary:
             req.add_header("Accept", codec.BINARY_MEDIA_TYPE)
         if body is not None:
-            if self.binary:
+            if self.binary and method != "PATCH":
                 data = codec.encode(body)
                 req.add_header("Content-Type", codec.BINARY_MEDIA_TYPE)
             else:
+                # PATCH always rides JSON: the dialect IS the Content-Type,
+                # and a binary body would make the server read the dialect
+                # as "merge" (patch bodies are partial docs/op lists — the
+                # typed binary codec has no frame for them anyway)
                 data = json.dumps(body).encode()
-                req.add_header("Content-Type", "application/json")
+                req.add_header("Content-Type", {
+                    "strategic": "application/strategic-merge-patch+json",
+                    "json": "application/json-patch+json",
+                    "merge": "application/merge-patch+json",
+                }.get(ptype, "application/json"))
         try:
             with urllib.request.urlopen(req, data=data,
                                         timeout=self.timeout) as r:
@@ -220,14 +233,18 @@ class ResourceClient:
         return self.transport.request(
             "PUT", self._path(ns, meta.name(obj), "status"), {}, obj)
 
-    def patch(self, name: str, patch: Obj, namespace: str = "default") -> Obj:
+    def patch(self, name: str, patch: Obj, namespace: str = "default",
+              patch_type: str = "merge") -> Obj:
+        q = {"__patchType": patch_type} if patch_type != "merge" else {}
         return self.transport.request("PATCH", self._path(namespace, name),
-                                      {}, patch)
+                                      q, patch)
 
     def patch_status(self, name: str, patch: Obj,
-                     namespace: str = "default") -> Obj:
+                     namespace: str = "default",
+                     patch_type: str = "merge") -> Obj:
+        q = {"__patchType": patch_type} if patch_type != "merge" else {}
         return self.transport.request(
-            "PATCH", self._path(namespace, name, "status"), {}, patch)
+            "PATCH", self._path(namespace, name, "status"), q, patch)
 
     def delete(self, name: str, namespace: str = "default",
                resource_version: str = "") -> Obj:

@@ -35,6 +35,7 @@ class ResourceInfo:
     subresources: Tuple[str, ...] = ()  # e.g. ("status", "binding")
     defaulter: Optional[DefaultFn] = None
     validator: Optional[ValidateFn] = None
+    custom: bool = False  # CRD-served: no struct tags → strategic patch 415
 
     def __post_init__(self) -> None:
         if not self.list_kind:

@@ -1,0 +1,380 @@
+"""Strategic merge patch + JSON patch (machinery/strategicpatch.py ⇔
+apimachinery/pkg/util/strategicpatch/patch.go + evanphx/json-patch), and
+the served PATCH dialects (apiserver patch.go patchTypes)."""
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.machinery import errors, meta
+from kubernetes_tpu.machinery.strategicpatch import (
+    json_patch, strategic_merge)
+
+
+class TestStrategicMergeUnit:
+    def test_container_list_merges_by_name(self):
+        cur = {"spec": {"containers": [
+            {"name": "app", "image": "app:v1", "env": [
+                {"name": "A", "value": "1"}]},
+            {"name": "sidecar", "image": "sc:v1"},
+        ]}}
+        patch = {"spec": {"containers": [
+            {"name": "app", "image": "app:v2"}]}}
+        out = strategic_merge(cur, patch)
+        by_name = {c["name"]: c for c in out["spec"]["containers"]}
+        assert by_name["app"]["image"] == "app:v2"
+        assert by_name["app"]["env"] == [{"name": "A", "value": "1"}]
+        assert by_name["sidecar"]["image"] == "sc:v1"  # sibling survives
+
+    def test_nested_env_and_volume_mounts_merge(self):
+        cur = {"spec": {"containers": [{
+            "name": "app",
+            "env": [{"name": "A", "value": "1"},
+                    {"name": "B", "value": "2"}],
+            "volumeMounts": [{"mountPath": "/data", "name": "d"}]}]}}
+        patch = {"spec": {"containers": [{
+            "name": "app",
+            "env": [{"name": "B", "value": "22"},
+                    {"name": "C", "value": "3"}],
+            "volumeMounts": [{"mountPath": "/logs", "name": "l"}]}]}}
+        out = strategic_merge(cur, patch)
+        c = out["spec"]["containers"][0]
+        assert {e["name"]: e["value"] for e in c["env"]} == {
+            "A": "1", "B": "22", "C": "3"}
+        assert {m["mountPath"] for m in c["volumeMounts"]} == {
+            "/data", "/logs"}
+
+    def test_patch_delete_directive(self):
+        cur = {"spec": {"containers": [
+            {"name": "app", "image": "a"}, {"name": "old", "image": "o"}]}}
+        patch = {"spec": {"containers": [
+            {"name": "old", "$patch": "delete"}]}}
+        out = strategic_merge(cur, patch)
+        assert [c["name"] for c in out["spec"]["containers"]] == ["app"]
+
+    def test_patch_replace_directive_on_list(self):
+        cur = {"spec": {"containers": [
+            {"name": "a"}, {"name": "b"}]}}
+        patch = {"spec": {"containers": [
+            {"$patch": "replace"}, {"name": "only"}]}}
+        out = strategic_merge(cur, patch)
+        assert [c["name"] for c in out["spec"]["containers"]] == ["only"]
+
+    def test_atomic_list_replaces(self):
+        # tolerations carries NO patchStrategy tag in the reference
+        # (core/v1 types.go:2976): wholesale replace
+        cur = {"spec": {"tolerations": [{"key": "a"}, {"key": "b"}]}}
+        patch = {"spec": {"tolerations": [{"key": "c"}]}}
+        out = strategic_merge(cur, patch)
+        assert out["spec"]["tolerations"] == [{"key": "c"}]
+
+    def test_primitive_merge_and_delete_from_primitive_list(self):
+        cur = {"metadata": {"finalizers": ["a", "b"]}}
+        out = strategic_merge(cur, {"metadata": {"finalizers": ["c"]}})
+        assert out["metadata"]["finalizers"] == ["a", "b", "c"]
+        out = strategic_merge(
+            cur, {"metadata": {"$deleteFromPrimitiveList/finalizers": ["a"]}})
+        assert out["metadata"]["finalizers"] == ["b"]
+
+    def test_set_element_order(self):
+        cur = {"spec": {"containers": [{"name": "a"}, {"name": "b"}]}}
+        patch = {"spec": {"$setElementOrder/containers": [
+            {"name": "b"}, {"name": "a"}]}}
+        # kubectl sends order lists of objects bearing only the merge key;
+        # our implementation accepts merge-key values too
+        patch = {"spec": {"$setElementOrder/containers": ["b", "a"]}}
+        out = strategic_merge(cur, patch)
+        assert [c["name"] for c in out["spec"]["containers"]] == ["b", "a"]
+
+    def test_retain_keys(self):
+        cur = {"spec": {"volumes": [
+            {"name": "v", "emptyDir": {}, "configMap": {"name": "cm"}}]}}
+        patch = {"spec": {"volumes": [
+            {"name": "v", "$retainKeys": ["name", "emptyDir"],
+             "emptyDir": {}}]}}
+        out = strategic_merge(cur, patch)
+        assert "configMap" not in out["spec"]["volumes"][0]
+
+    def test_service_ports_merge_by_port(self):
+        cur = {"spec": {"ports": [
+            {"port": 80, "nodePort": 30080}, {"port": 443}]}}
+        patch = {"spec": {"ports": [{"port": 443, "name": "tls"}]}}
+        out = strategic_merge(cur, patch)
+        by_port = {p["port"]: p for p in out["spec"]["ports"]}
+        assert by_port[80]["nodePort"] == 30080
+        assert by_port[443]["name"] == "tls"
+
+    def test_container_ports_merge_by_container_port(self):
+        cur = {"spec": {"containers": [{
+            "name": "app", "ports": [{"containerPort": 8080}]}]}}
+        patch = {"spec": {"containers": [{
+            "name": "app", "ports": [{"containerPort": 9090}]}]}}
+        out = strategic_merge(cur, patch)
+        assert {p["containerPort"]
+                for p in out["spec"]["containers"][0]["ports"]} == \
+            {8080, 9090}
+
+    def test_null_deletes_map_key(self):
+        out = strategic_merge({"metadata": {"labels": {"a": "1", "b": "2"}}},
+                              {"metadata": {"labels": {"a": None}}})
+        assert out["metadata"]["labels"] == {"b": "2"}
+
+
+class TestJSONPatchUnit:
+    def test_ops(self):
+        doc = {"spec": {"replicas": 1, "paused": True},
+               "metadata": {"labels": {"a": "1"}}}
+        out = json_patch(doc, [
+            {"op": "test", "path": "/spec/replicas", "value": 1},
+            {"op": "replace", "path": "/spec/replicas", "value": 3},
+            {"op": "remove", "path": "/spec/paused"},
+            {"op": "add", "path": "/metadata/labels/b", "value": "2"},
+            {"op": "copy", "from": "/metadata/labels/a",
+             "path": "/metadata/labels/c"},
+            {"op": "move", "from": "/metadata/labels/c",
+             "path": "/metadata/labels/d"},
+        ])
+        assert out["spec"] == {"replicas": 3}
+        assert out["metadata"]["labels"] == {"a": "1", "b": "2", "d": "1"}
+
+    def test_list_ops_and_failed_test(self):
+        doc = {"a": [1, 2, 3]}
+        out = json_patch(doc, [{"op": "add", "path": "/a/1", "value": 9},
+                               {"op": "remove", "path": "/a/0"},
+                               {"op": "add", "path": "/a/-", "value": 4}])
+        assert out["a"] == [9, 2, 3, 4]
+        with pytest.raises(errors.StatusError):
+            json_patch(doc, [{"op": "test", "path": "/a/0", "value": 99}])
+
+
+@pytest.fixture
+def api():
+    a = APIServer()
+    yield a
+    a.close()
+
+
+@pytest.fixture
+def client(api):
+    return Client.local(api)
+
+
+def _deploy(name="web"):
+    return {"apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"replicas": 2,
+                     "selector": {"matchLabels": {"app": name}},
+                     "template": {
+                         "metadata": {"labels": {"app": name}},
+                         "spec": {"containers": [
+                             {"name": "app", "image": "app:v1"},
+                             {"name": "sidecar", "image": "sc:v1"}]}}}}
+
+
+class TestServedPatchDialects:
+    def test_strategic_patch_preserves_sibling_containers(self, client):
+        client.deployments.create(_deploy())
+        client.deployments.patch(
+            "web",
+            {"spec": {"template": {"spec": {"containers": [
+                {"name": "app", "image": "app:v2"}]}}}},
+            "default", patch_type="strategic")
+        got = client.deployments.get("web")
+        by_name = {c["name"]: c["image"] for c in
+                   got["spec"]["template"]["spec"]["containers"]}
+        assert by_name == {"app": "app:v2", "sidecar": "sc:v1"}
+
+    def test_merge_patch_still_replaces(self, client):
+        client.deployments.create(_deploy())
+        client.deployments.patch(
+            "web",
+            {"spec": {"template": {"spec": {"containers": [
+                {"name": "app", "image": "app:v2"}]}}}},
+            "default")
+        got = client.deployments.get("web")
+        assert [c["name"] for c in
+                got["spec"]["template"]["spec"]["containers"]] == ["app"]
+
+    def test_json_patch_dialect(self, client):
+        client.deployments.create(_deploy())
+        client.deployments.patch(
+            "web", [{"op": "replace", "path": "/spec/replicas", "value": 7}],
+            "default", patch_type="json")
+        assert client.deployments.get("web")["spec"]["replicas"] == 7
+
+    def test_kubectl_apply_merges_container_list(self, client, tmp_path):
+        import json as _json
+
+        from kubernetes_tpu.cli.kubectl import Kubectl
+
+        client.deployments.create(_deploy())
+        mod = _deploy()
+        mod["spec"]["template"]["spec"]["containers"] = [
+            {"name": "app", "image": "app:v3"}]
+        f = tmp_path / "d.json"
+        f.write_text(_json.dumps(mod))
+        Kubectl(client).apply(str(f))
+        got = client.deployments.get("web")
+        by_name = {c["name"]: c["image"] for c in
+                   got["spec"]["template"]["spec"]["containers"]}
+        # apply MERGES: sidecar survives, app updates
+        assert by_name == {"app": "app:v3", "sidecar": "sc:v1"}
+
+    def test_strategic_on_custom_resource_is_415(self, api, client):
+        crd = {"apiVersion": "apiextensions.k8s.io/v1",
+               "kind": "CustomResourceDefinition",
+               "metadata": {"name": "tjobs.ml.example.com"},
+               "spec": {"group": "ml.example.com", "scope": "Namespaced",
+                        "names": {"plural": "tjobs", "kind": "TJob"},
+                        "versions": [{"name": "v1", "served": True,
+                                      "storage": True}]}}
+        client.customresourcedefinitions.create(crd)
+        tj = client.resource("ml.example.com", "v1", "tjobs", True)
+        tj.create({"apiVersion": "ml.example.com/v1", "kind": "TJob",
+                   "metadata": {"name": "j", "namespace": "default"},
+                   "spec": {"replicas": 1}})
+        with pytest.raises(errors.StatusError) as ei:
+            tj.patch("j", {"spec": {"replicas": 2}}, "default",
+                     patch_type="strategic")
+        assert ei.value.code == 415
+        # merge still works
+        tj.patch("j", {"spec": {"replicas": 2}}, "default")
+        assert tj.get("j")["spec"]["replicas"] == 2
+
+
+class TestCRPatchThroughConversion:
+    MULTIVER_CRD = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "widgets.shop.example.com"},
+        "spec": {
+            "group": "shop.example.com",
+            "scope": "Namespaced",
+            "names": {"plural": "widgets", "kind": "Widget"},
+            "conversion": {
+                "strategy": "Webhook",
+                "webhook": {"clientConfig":
+                            {"url": "local://widget-conv-patch"}},
+            },
+            "versions": [
+                {"name": "v1", "served": True, "storage": True},
+                {"name": "v2", "served": True, "storage": False},
+            ],
+        },
+    }
+
+    @staticmethod
+    def _converter(review):
+        req = review["request"]
+        want = req["desiredAPIVersion"].rsplit("/", 1)[1]
+        out = []
+        for o in req["objects"]:
+            o = meta.deep_copy(o)
+            spec = dict(o.get("spec", {}))
+            if want == "v2" and "size" in spec:
+                spec["replicas"] = spec.pop("size")
+            elif want == "v1" and "replicas" in spec:
+                spec["size"] = spec.pop("replicas")
+            o["spec"] = spec
+            out.append(o)
+        return {"response": {"uid": req["uid"],
+                             "result": {"status": "Success"},
+                             "convertedObjects": out}}
+
+    def test_patch_applies_at_request_version(self, api, client):
+        """PARITY #16: a v2 PATCH body names v2 FIELDS (spec.replicas); the
+        server must apply it against the v2 view and store the v1 form —
+        patching the storage object directly would bolt spec.replicas onto
+        a v1 object that uses spec.size."""
+        from kubernetes_tpu.apiserver.webhooks import (
+            register_local_webhook, unregister_local_webhook,
+        )
+
+        register_local_webhook("local://widget-conv-patch", self._converter)
+        try:
+            client.customresourcedefinitions.create(self.MULTIVER_CRD)
+            w1 = client.resource("shop.example.com", "v1", "widgets", True)
+            w2 = client.resource("shop.example.com", "v2", "widgets", True)
+            w1.create({"apiVersion": "shop.example.com/v1", "kind": "Widget",
+                       "metadata": {"name": "a", "namespace": "default"},
+                       "spec": {"size": 3}})
+            out = w2.patch("a", {"spec": {"replicas": 9}}, "default")
+            assert out["apiVersion"] == "shop.example.com/v2"
+            assert out["spec"] == {"replicas": 9}
+            # stored at v1: size, not a stray replicas field
+            assert w1.get("a")["spec"] == {"size": 9}
+        finally:
+            unregister_local_webhook("local://widget-conv-patch")
+
+
+class TestReviewFindings:
+    """Follow-ups from the round-5 review of the patch machinery."""
+
+    def test_json_patch_bad_tokens_are_400(self):
+        doc = {"a": [1], "m": {}}
+        for ops in ([{"op": "replace", "path": "/a/x", "value": 0}],
+                    [{"op": "remove", "path": "/a/5"}],
+                    [{"op": "remove", "path": ""}],
+                    [{"op": "test", "path": "/m/missing", "value": None}]):
+            with pytest.raises(errors.StatusError) as ei:
+                json_patch(doc, ops)
+            assert ei.value.code == 400, ops
+
+    def test_apply_removes_deleted_container(self, client, tmp_path):
+        """3-way apply: deleting an entry from the manifest's merge list
+        deletes it from the live object (was silently kept by a plain
+        2-way strategic merge)."""
+        import json as _json
+
+        from kubernetes_tpu.cli.kubectl import Kubectl
+
+        kc = Kubectl(client)
+        f = tmp_path / "d.json"
+        f.write_text(_json.dumps(_deploy()))
+        kc.apply(str(f))          # create (records last-applied)
+        mod = _deploy()
+        mod["spec"]["template"]["spec"]["containers"] = [
+            {"name": "app", "image": "app:v2"}]   # sidecar removed
+        f.write_text(_json.dumps(mod))
+        kc.apply(str(f))
+        got = client.deployments.get("web")
+        assert [c["name"] for c in
+                got["spec"]["template"]["spec"]["containers"]] == ["app"]
+        assert got["spec"]["template"]["spec"]["containers"][0]["image"] \
+            == "app:v2"
+
+    def test_apply_keeps_controller_set_fields(self, client, tmp_path):
+        """3-way: fields NOT in the manifest and NOT in last-applied (e.g.
+        set by a controller or another client) survive apply."""
+        import json as _json
+
+        from kubernetes_tpu.cli.kubectl import Kubectl
+
+        kc = Kubectl(client)
+        f = tmp_path / "d.json"
+        f.write_text(_json.dumps(_deploy()))
+        kc.apply(str(f))
+        # a controller annotates the live object out-of-band
+        client.deployments.patch(
+            "web", {"metadata": {"annotations": {"owned-by": "hpa"}}},
+            "default")
+        kc.apply(str(f))  # re-apply same manifest
+        got = client.deployments.get("web")
+        assert got["metadata"]["annotations"].get("owned-by") == "hpa"
+
+    def test_apply_removes_deleted_label(self, client, tmp_path):
+        import json as _json
+
+        from kubernetes_tpu.cli.kubectl import Kubectl
+
+        kc = Kubectl(client)
+        d = _deploy()
+        d["metadata"]["labels"] = {"team": "a", "tier": "web"}
+        f = tmp_path / "d.json"
+        f.write_text(_json.dumps(d))
+        kc.apply(str(f))
+        d["metadata"]["labels"] = {"team": "a"}
+        f.write_text(_json.dumps(d))
+        kc.apply(str(f))
+        got = client.deployments.get("web")
+        assert "tier" not in got["metadata"].get("labels", {})
