@@ -216,6 +216,9 @@ class Store:
         ("merge"), strategic merge ("strategic" —
         apimachinery/pkg/util/strategicpatch), RFC 6902 op list ("json")."""
 
+        if patch_type != "json" and not isinstance(patch, dict):
+            raise errors.new_bad_request(
+                f"a {patch_type} patch body must be a JSON object")
         if patch_type == "strategic" and self.info.custom:
             # custom resources have no patchStrategy struct tags; the
             # reference's CR handler rejects SMP with 415 (patch.go,

@@ -618,10 +618,10 @@ def _handle_rest_inner(api: APIServer, method: str, path: str,
                 return 200, st.update(namespace, name, body or {},
                                       subresource="status")
             if method == "PATCH":
-                return 200, st.patch(namespace, name, body or {},
-                                     subresource="status",
-                                     patch_type=query.get("__patchType",
-                                                          "merge"))
+                return 200, st.patch(
+                    namespace, name, {} if body is None else body,
+                    subresource="status",
+                    patch_type=query.get("__patchType", "merge"))
         raise errors.new_method_not_supported(f"{resource}/{sub}", method)
 
     if watching:
@@ -633,7 +633,9 @@ def _handle_rest_inner(api: APIServer, method: str, path: str,
     if method == "PUT":
         return 200, st.update(namespace, name, body or {})
     if method == "PATCH":
-        return 200, st.patch(namespace, name, body or {},
+        # `body or {}` would collapse an EMPTY json-patch op list (a legal
+        # no-op) into a dict and 400 it
+        return 200, st.patch(namespace, name, {} if body is None else body,
                              patch_type=query.get("__patchType", "merge"))
     if method == "DELETE":
         if info.resource == "namespaces":

@@ -70,6 +70,12 @@ class FakeCRI:
         # exec/http/tcp probe outcomes ("readiness" | "liveness")
         self.probe_policy: Callable[[str, str], bool] = \
             lambda image, kind: True
+        # ImageService accounting (images dict holds name → sizeBytes):
+        # size_policy sizes newly-pulled images; last-used times feed the
+        # image GC manager's LRU ordering; imagefs capacity bounds usage
+        self.size_policy: Callable[[str], int] = lambda image: 256 << 20
+        self.image_last_used: Dict[str, float] = {}
+        self.image_fs_capacity: int = 100 << 30
 
     # -- RuntimeService ----------------------------------------------------- #
 
@@ -104,7 +110,8 @@ class FakeCRI:
         with self._mu:
             sb = self.sandboxes[sid]
             cid = f"container-{uuid.uuid4().hex[:12]}"
-            self.images.setdefault(image, 1)
+            self._pull_locked(image)
+            self.image_last_used[image] = self.clock()
             sb.containers[cid] = FakeContainer(
                 cid, name, image, sid, exit_after=self.exit_policy(image))
             return cid
@@ -159,6 +166,43 @@ class FakeCRI:
         if c is None or c.state != CONTAINER_RUNNING:
             return False
         return bool(self.probe_policy(c.image, kind))
+
+    # -- ImageService (api.proto ImageService) ------------------------------ #
+
+    def _pull_locked(self, image: str) -> None:
+        if image not in self.images:
+            self.images[image] = int(self.size_policy(image))
+
+    def pull_image(self, image: str) -> None:
+        """PullImage: materialize the image on the node's imagefs."""
+        with self._mu:
+            self._pull_locked(image)
+            self.image_last_used[image] = self.clock()
+
+    def list_images(self) -> List[dict]:
+        """ListImages: name/size/lastUsed, plus whether any container
+        (running or not) still references the image — GC exempts those
+        (image_gc_manager.go detectImages imagesInUse)."""
+        with self._mu:
+            in_use = {c.image for sb in self.sandboxes.values()
+                      for c in sb.containers.values()}
+            return [{"name": name, "sizeBytes": size,
+                     "lastUsed": self.image_last_used.get(name, 0.0),
+                     "inUse": name in in_use}
+                    for name, size in self.images.items()]
+
+    def remove_image(self, image: str) -> None:
+        with self._mu:
+            self.images.pop(image, None)
+            self.image_last_used.pop(image, None)
+
+    def image_fs_info(self) -> dict:
+        """ImageFsInfo: capacity/used bytes of the image filesystem — the
+        signal both the image GC thresholds and the nodefs eviction signal
+        read."""
+        with self._mu:
+            return {"capacityBytes": self.image_fs_capacity,
+                    "usedBytes": sum(self.images.values())}
 
     def list_stats(self) -> List[dict]:
         """ListContainerStats (api.proto RuntimeService): per-running-container

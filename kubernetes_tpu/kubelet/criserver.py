@@ -146,8 +146,15 @@ class CRIServer:
             c = rt.container_status(p["containerId"])
             return {"status": _container_wire(c) if c is not None else None}
         if method == "ListImages":
-            with rt._mu:
-                return {"images": sorted(rt.images)}
+            return {"images": rt.list_images()}
+        if method == "PullImage":
+            rt.pull_image(p["image"])
+            return {}
+        if method == "RemoveImage":
+            rt.remove_image(p["image"])
+            return {}
+        if method == "ImageFsInfo":
+            return rt.image_fs_info()
         if method == "ListContainerStats":
             return {"stats": rt.list_stats()}
         if method == "Probe":  # the prober's check, policy-backed (fake)
@@ -348,6 +355,20 @@ class RemoteCRI:
 
     def version(self) -> Dict[str, Any]:
         return self._call("Version")
+
+    # -- ImageService -------------------------------------------------- #
+
+    def pull_image(self, image: str) -> None:
+        self._call("PullImage", image=image)
+
+    def list_images(self) -> List[Dict[str, Any]]:
+        return self._call("ListImages")["images"]
+
+    def remove_image(self, image: str) -> None:
+        self._call("RemoveImage", image=image)
+
+    def image_fs_info(self) -> Dict[str, Any]:
+        return self._call("ImageFsInfo")
 
     def set_exit_rules(self, rules: List[Tuple[str, float]]) -> None:
         self._call("SetExitRules", rules=[list(r) for r in rules])

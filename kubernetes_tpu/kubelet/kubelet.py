@@ -41,7 +41,14 @@ class Kubelet:
                  housekeeping_interval: float = 0.5,
                  checkpoint_dir: Optional[str] = None,
                  eviction_hard: Optional[Dict[str, str]] = None,
+                 system_reserved: Optional[Dict[str, str]] = None,
+                 kube_reserved: Optional[Dict[str, str]] = None,
+                 image_gc_high_percent: int = 85,
+                 image_gc_low_percent: int = 80,
+                 image_gc_period: float = 10.0,
                  clock=time.time):
+        from kubernetes_tpu.kubelet.cm import ContainerManager, ImageGCManager
+
         self.client = client
         self.node_name = node_name
         self.capacity = capacity or {"cpu": "8", "memory": "16Gi",
@@ -85,6 +92,18 @@ class Kubelet:
         # Failed status propagates through the watch (cleared at teardown)
         self._evicted: set = set()
         self._pending_evict_writes: Dict[str, Obj] = {}
+        # container manager (kubelet/cm.py): node allocatable = capacity -
+        # reservations, and the canAdmitPod gate _sync_pod runs before a
+        # sandbox exists. Rejected uids behave like evicted ones: no
+        # resurrection while the Failed status propagates.
+        self.container_manager = ContainerManager(
+            self.capacity, system_reserved, kube_reserved)
+        self._rejected: set = set()
+        self._pending_reject_writes: Dict[str, tuple] = {}
+        self.image_gc = ImageGCManager(self.cri, image_gc_high_percent,
+                                       image_gc_low_percent)
+        self._image_gc_period = image_gc_period
+        self._last_image_gc = 0.0
 
     # ------------------------------------------------------------------ #
     # node registration + heartbeat (kubelet_node_status.go)
@@ -97,7 +116,7 @@ class Kubelet:
             "spec": {},
             "status": {
                 "capacity": dict(self.capacity),
-                "allocatable": dict(self.capacity),
+                "allocatable": self.container_manager.allocatable(),
                 "conditions": [self._ready_condition()],
                 "nodeInfo": {"kubeletVersion": "v1.17.0-tpu.1"},
                 "addresses": [{"type": "Hostname",
@@ -135,7 +154,8 @@ class Kubelet:
                     else "KubeletHasSufficientMemory"})
             node.setdefault("status", {})["conditions"] = conds
             node["status"]["capacity"] = dict(self.capacity)
-            node["status"].setdefault("allocatable", dict(self.capacity))
+            node["status"]["allocatable"] = \
+                self.container_manager.allocatable()
             self.client.nodes.update_status(node, "")
         except errors.StatusError:
             pass
@@ -214,8 +234,19 @@ class Kubelet:
                     if self._write_evicted_status(pod):
                         with self._pod_mu:
                             self._pending_evict_writes.pop(uid, None)
+                with self._pod_mu:
+                    reject_writes = list(
+                        self._pending_reject_writes.items())
+                for uid, (pod, reason, message) in reject_writes:
+                    if self._write_failed_status(pod, reason, message):
+                        with self._pod_mu:
+                            self._pending_reject_writes.pop(uid, None)
                 if self.eviction_hard:
                     self._check_eviction()
+                now = self.clock()
+                if now - self._last_image_gc >= self._image_gc_period:
+                    self._last_image_gc = now
+                    self.image_gc.garbage_collect()
             except Exception:  # noqa: BLE001 — node loops never die
                 pass
 
@@ -238,22 +269,57 @@ class Kubelet:
             return
         uid = meta.uid(pod)
         phase = pod.get("status", {}).get("phase", "")
-        if phase in ("Succeeded", "Failed") or uid in self._evicted:
+        if phase in ("Succeeded", "Failed") or uid in self._evicted \
+                or uid in self._rejected:
             return
         with self._pod_mu:
-            if uid in self._evicted:
+            if uid in self._evicted or uid in self._rejected:
                 # re-checked UNDER the lock: a sync that passed the outer
                 # guard while _evict_pod held the lock must not recreate
                 # the sandbox it just destroyed
                 return
             sid = self._sandbox_by_uid.get(uid)
             if sid is None:
-                sid = self.cri.run_pod_sandbox(meta.name(pod),
-                                               meta.namespace(pod), uid)
-                # recorded IMMEDIATELY so a CRIError later in this sync
-                # leaves resumable bookkeeping, not a leaked sandbox
-                self._sandbox_by_uid[uid] = sid
-                self._containers_by_uid[uid] = []
+                # canAdmitPod (kubelet.go HandlePodAdditions): the NODE
+                # enforces allocatable against already-admitted pods —
+                # the scheduler's arithmetic is advisory (stale caches,
+                # static pods, competing schedulers can all overcommit)
+                active = [p for p in self._informer.lister.list()
+                          if meta.uid(p) in self._sandbox_by_uid
+                          and meta.uid(p) not in self._evicted
+                          and p.get("status", {}).get("phase", "")
+                          not in ("Succeeded", "Failed")] \
+                    if self._informer else []
+                ok, reason, message = self.container_manager.admit(
+                    pod, active)
+                if not ok:
+                    # rejectPod: no sandbox is ever created; the Failed
+                    # status (reason OutOfcpu/OutOfmemory/OutOfpods)
+                    # writes outside the lock, housekeeping re-drives it
+                    self._rejected.add(uid)
+                    self._pending_reject_writes[uid] = (pod, reason,
+                                                        message)
+                    rejection = (pod, reason, message)
+                else:
+                    rejection = None
+                    sid = self.cri.run_pod_sandbox(meta.name(pod),
+                                                   meta.namespace(pod), uid)
+                    # recorded IMMEDIATELY so a CRIError later in this
+                    # sync leaves resumable bookkeeping, never a leaked
+                    # sandbox
+                    self._sandbox_by_uid[uid] = sid
+                    self._containers_by_uid[uid] = []
+            else:
+                rejection = None
+        if rejection is not None:
+            if self._write_failed_status(*rejection):
+                with self._pod_mu:
+                    self._pending_reject_writes.pop(uid, None)
+            return
+        with self._pod_mu:
+            if uid in self._evicted or self._sandbox_by_uid.get(uid) is None:
+                return
+            sid = self._sandbox_by_uid[uid]
             cids = self._containers_by_uid.setdefault(uid, [])
             spec_containers = pod.get("spec", {}).get("containers", []) or []
             # resume container creation where a partial sync stopped (the
@@ -304,6 +370,8 @@ class Kubelet:
         self.under_memory_pressure = pressure
         if not pressure:
             return
+        from kubernetes_tpu.kubelet.cm import pod_requests
+
         victims = []
         for pod in self._informer.lister.list() if self._informer else []:
             phase = pod.get("status", {}).get("phase", "")
@@ -312,14 +380,20 @@ class Kubelet:
                 continue
             if uid not in usage:
                 continue
-            victims.append((int(pod.get("spec", {}).get("priority", 0) or 0),
-                            -usage[uid], meta.namespaced_key(pod), pod))
+            # rankMemoryPressure (eviction/helpers.go): pods whose usage
+            # EXCEEDS their request evict first, then lower priority, then
+            # the largest usage-over-request
+            _, req_kib = pod_requests(pod)
+            over = usage[uid] - req_kib * 1024
+            victims.append((0 if over > 0 else 1,
+                            int(pod.get("spec", {}).get("priority", 0) or 0),
+                            -over, meta.namespaced_key(pod), pod))
         if not victims:
             return
         # key excludes the pod dict: rank ties must not fall through to
         # (unorderable) dict comparison
-        victims.sort(key=lambda v: v[:3])
-        self._evict_pod(victims[0][3])
+        victims.sort(key=lambda v: v[:4])
+        self._evict_pod(victims[0][4])
 
     def _evict_pod(self, pod: Obj) -> None:
         """Kill the pod's containers and report Failed/Evicted — the
@@ -351,14 +425,18 @@ class Kubelet:
                 self._pending_evict_writes[meta.uid(pod)] = pod
 
     def _write_evicted_status(self, pod: Obj) -> bool:
+        return self._write_failed_status(
+            pod, "Evicted", "The node was low on resource: memory.")
+
+    def _write_failed_status(self, pod: Obj, reason: str,
+                             message: str) -> bool:
         for _ in range(5):  # CAS-retry: informer status writes race this
             try:
                 cur = self.client.pods.get(meta.name(pod),
                                            meta.namespace(pod))
                 cur["status"] = {**cur.get("status", {}),
-                                 "phase": "Failed", "reason": "Evicted",
-                                 "message": "The node was low on resource: "
-                                            "memory."}
+                                 "phase": "Failed", "reason": reason,
+                                 "message": message}
                 self.client.pods.update_status(cur, meta.namespace(pod))
                 return True
             except errors.StatusError as e:
@@ -495,6 +573,8 @@ class Kubelet:
             self._pending_teardowns.pop(uid, None)
             self._pending_evict_writes.pop(uid, None)
             self._evicted.discard(uid)
+            self._rejected.discard(uid)
+            self._pending_reject_writes.pop(uid, None)
             for d in (self._probe_state, self._restart_counts):
                 for k in [k for k in d if k[0] == uid]:
                     del d[k]

@@ -216,11 +216,20 @@ def _merge_list(cur: Any, patch: List[Any], path: Tuple[str, ...],
 def _reorder(items: List[Any], order: List[Any],
              key: Optional[str]) -> List[Any]:
     """$setElementOrder: listed elements first in the given order, then the
-    unlisted ones in their current relative order (patch.go order merge)."""
+    unlisted ones in their current relative order (patch.go order merge).
+    Order entries come as objects bearing only the merge key (what kubectl
+    emits) or as bare merge-key values; both normalize to the key value."""
     def sort_value(e):
         return e.get(key) if (key and isinstance(e, dict)) else e
 
-    pos = {v: i for i, v in enumerate(order)}
+    pos: Dict[Any, int] = {}
+    for i, v in enumerate(order):
+        v = sort_value(v)
+        if isinstance(v, (dict, list)):
+            raise errors.new_bad_request(
+                "invalid $setElementOrder entry (expected merge-key value "
+                "or an object bearing the merge key)")
+        pos.setdefault(v, i)
     listed = [e for e in items if sort_value(e) in pos]
     unlisted = [e for e in items if sort_value(e) not in pos]
     listed.sort(key=lambda e: pos[sort_value(e)])

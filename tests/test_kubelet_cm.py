@@ -1,0 +1,216 @@
+"""Kubelet container manager + image GC (kubelet/cm.py ⇔
+pkg/kubelet/cm/container_manager_linux.go canAdmitPod path +
+pkg/kubelet/images/image_gc_manager.go)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.kubelet import FakeCRI, Kubelet
+from kubernetes_tpu.kubelet.cm import (
+    ContainerManager, ImageGCManager, pod_qos, pod_requests)
+from kubernetes_tpu.machinery import meta
+
+
+def podspec(name, cpu="100m", mem="128Mi", node=None, uid=None, owner=None):
+    p = {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": name, "namespace": "default"},
+         "spec": {"containers": [{
+             "name": "c", "image": "i",
+             "resources": {"requests": {"cpu": cpu, "memory": mem}}}]}}
+    if node:
+        p["spec"]["nodeName"] = node
+    if uid:
+        p["metadata"]["uid"] = uid
+    if owner:
+        p["metadata"]["ownerReferences"] = [owner]
+    return p
+
+
+class TestContainerManager:
+    def test_allocatable_subtracts_reservations(self):
+        cm = ContainerManager({"cpu": "4", "memory": "8Gi", "pods": "110"},
+                              system_reserved={"cpu": "500m",
+                                               "memory": "1Gi"},
+                              kube_reserved={"cpu": "500m"})
+        alloc = cm.allocatable()
+        assert alloc["cpu"] == "3000m"
+        assert alloc["memory"] == f"{7 * (1 << 20)}Ki"
+
+    def test_admit_out_of_cpu_memory_pods(self):
+        cm = ContainerManager({"cpu": "1", "memory": "1Gi", "pods": "2"})
+        active = [podspec("a", cpu="600m", mem="256Mi")]
+        ok, _, _ = cm.admit(podspec("b", cpu="300m", mem="256Mi"), active)
+        assert ok
+        ok, reason, msg = cm.admit(podspec("c", cpu="600m"), active)
+        assert not ok and reason == "OutOfcpu" and "cpu" in msg
+        ok, reason, _ = cm.admit(podspec("d", cpu="100m", mem="900Mi"),
+                                 active)
+        assert not ok and reason == "OutOfmemory"
+        ok, reason, _ = cm.admit(
+            podspec("e", cpu="1m", mem="1Mi"),
+            [podspec("a"), podspec("b")])
+        assert not ok and reason == "OutOfpods"
+
+    def test_qos_classes(self):
+        guaranteed = {"spec": {"containers": [{
+            "name": "c", "resources": {
+                "requests": {"cpu": "1", "memory": "1Gi"},
+                "limits": {"cpu": "1", "memory": "1Gi"}}}]}}
+        burstable = podspec("b")
+        besteffort = {"spec": {"containers": [{"name": "c"}]}}
+        assert pod_qos(guaranteed) == "Guaranteed"
+        assert pod_qos(burstable) == "Burstable"
+        assert pod_qos(besteffort) == "BestEffort"
+
+    def test_pod_requests_init_containers_max(self):
+        p = podspec("p", cpu="200m", mem="128Mi")
+        p["spec"]["initContainers"] = [{
+            "name": "init", "resources": {
+                "requests": {"cpu": "1", "memory": "64Mi"}}}]
+        cpu, mem = pod_requests(p)
+        assert cpu == 1000          # init dominates cpu
+        assert mem == 128 * 1024    # app containers dominate memory
+
+
+class TestImageGC:
+    def _cri(self):
+        cri = FakeCRI(clock=time.monotonic)
+        cri.image_fs_capacity = 1000
+        cri.size_policy = lambda image: 100
+        return cri
+
+    def test_gc_frees_to_low_watermark_lru_first(self):
+        cri = self._cri()
+        now = time.monotonic()
+        for i in range(9):  # 900/1000 = 90% > high (85%)
+            cri.pull_image(f"img-{i}")
+            cri.image_last_used[f"img-{i}"] = now - (9 - i)
+        gc = ImageGCManager(cri, high_threshold_percent=85,
+                            low_threshold_percent=50)
+        freed = gc.garbage_collect()
+        assert freed == 400  # 900 → 500 target, 4 images
+        # oldest-last-used went first
+        assert set(cri.images) == {f"img-{i}" for i in range(4, 9)}
+
+    def test_gc_noop_below_high(self):
+        cri = self._cri()
+        for i in range(5):  # 50%
+            cri.pull_image(f"img-{i}")
+        gc = ImageGCManager(cri)
+        assert gc.garbage_collect() == 0
+        assert len(cri.images) == 5
+
+    def test_in_use_images_exempt(self):
+        cri = self._cri()
+        sid = cri.run_pod_sandbox("p", "default", "u1")
+        cri.create_container(sid, "c", "img-used")
+        for i in range(9):
+            cri.pull_image(f"img-{i}")
+        gc = ImageGCManager(cri, high_threshold_percent=50,
+                            low_threshold_percent=1)
+        gc.garbage_collect()
+        assert "img-used" in cri.images  # referenced by a container
+
+
+def wait_for(cond, timeout=30.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+class TestKubeletAdmission:
+    def test_overcommitted_pod_rejected_and_rescheduled(self):
+        """VERDICT r4 item 5's done-bar: a pod landing on a full node is
+        rejected by the KUBELET (OutOfcpu, phase Failed), and its
+        ReplicaSet owner replaces it — the replacement schedules onto the
+        other node. The overcommit source is a scheduler-bypassing bound
+        pod (the static-pod/stale-scheduler seat: spec.nodeName set at
+        create)."""
+        from kubernetes_tpu.controllers import ControllerManager
+        from kubernetes_tpu.sched.server import SchedulerServer
+
+        api = APIServer()
+        client = Client.local(api)
+        k1 = Kubelet(client, "full", capacity={"cpu": "1", "memory": "2Gi",
+                                               "pods": "10"},
+                     housekeeping_interval=0.2)
+        k2 = Kubelet(client, "roomy", capacity={"cpu": "8", "memory": "8Gi",
+                                                "pods": "110"},
+                     housekeeping_interval=0.2)
+        sched = SchedulerServer(client).start()
+        cm = ControllerManager(client, controllers=["replicaset"],
+                               poll_interval=0.2).start()
+        try:
+            k1.start()
+            k2.start()
+            # occupy the small node via the scheduler (600m of 1 cpu)
+            client.pods.create(podspec("tenant", cpu="600m", node="full"))
+            assert wait_for(lambda: client.pods.get("tenant")
+                            .get("status", {}).get("phase") == "Running")
+
+            # an RS whose pod is BOUND to the full node by fiat (the
+            # scheduler-bypass path) and cannot fit: kubelet must reject
+            rs = {"apiVersion": "apps/v1", "kind": "ReplicaSet",
+                  "metadata": {"name": "rs1", "namespace": "default",
+                               "uid": "rs-uid-1"},
+                  "spec": {"replicas": 1,
+                           "selector": {"matchLabels": {"app": "rs1"}},
+                           "template": {
+                               "metadata": {"labels": {"app": "rs1"}},
+                               "spec": {"containers": [{
+                                   "name": "c", "image": "i",
+                                   "resources": {"requests": {
+                                       "cpu": "700m",
+                                       "memory": "128Mi"}}}]}}}}
+            client.replicasets.create(rs)
+            owner = {"apiVersion": "apps/v1", "kind": "ReplicaSet",
+                     "name": "rs1", "uid": "rs-uid-1", "controller": True}
+            doomed = podspec("rs1-doomed", cpu="700m", mem="128Mi",
+                             node="full", owner=owner)
+            doomed["metadata"]["labels"] = {"app": "rs1"}
+            client.pods.create(doomed)
+
+            # kubelet rejects: Failed + OutOfcpu, and no sandbox exists
+            assert wait_for(lambda: client.pods.get("rs1-doomed")
+                            .get("status", {}).get("phase") == "Failed")
+            got = client.pods.get("rs1-doomed")
+            assert got["status"]["reason"] == "OutOfcpu"
+            assert k1.cri.sandbox_for_pod(meta.uid(got)) is None
+
+            # the RS replaces it; the scheduler lands the replacement on
+            # the roomy node and it runs
+            def replacement_running():
+                pods = client.pods.list(
+                    "default", label_selector="app=rs1")["items"]
+                live = [p for p in pods
+                        if p.get("status", {}).get("phase") == "Running"]
+                return any(p["spec"].get("nodeName") == "roomy"
+                           for p in live)
+
+            assert wait_for(replacement_running, timeout=60)
+        finally:
+            cm.stop()
+            sched.stop()
+            k1.stop()
+            k2.stop()
+            api.close()
+
+    def test_node_reports_reserved_allocatable(self):
+        api = APIServer()
+        client = Client.local(api)
+        k = Kubelet(client, "n1",
+                    capacity={"cpu": "4", "memory": "8Gi", "pods": "110"},
+                    system_reserved={"cpu": "1", "memory": "2Gi"})
+        try:
+            k.register_node()
+            node = client.nodes.get("n1", "")
+            assert node["status"]["allocatable"]["cpu"] == "3000m"
+            assert node["status"]["capacity"]["cpu"] == "4"
+        finally:
+            api.close()

@@ -378,3 +378,24 @@ class TestReviewFindings:
         kc.apply(str(f))
         got = client.deployments.get("web")
         assert "tier" not in got["metadata"].get("labels", {})
+
+    def test_set_element_order_object_form(self):
+        # what kubectl actually emits: objects bearing only the merge key
+        cur = {"spec": {"containers": [{"name": "a"}, {"name": "b"}]}}
+        patch = {"spec": {"$setElementOrder/containers": [
+            {"name": "b"}, {"name": "a"}]}}
+        out = strategic_merge(cur, patch)
+        assert [c["name"] for c in out["spec"]["containers"]] == ["b", "a"]
+
+    def test_list_body_on_strategic_patch_is_400(self, client):
+        client.deployments.create(_deploy("listbody"))
+        with pytest.raises(errors.StatusError) as ei:
+            client.deployments.patch("listbody", [{"x": 1}], "default",
+                                     patch_type="strategic")
+        assert ei.value.code == 400
+
+    def test_empty_json_patch_is_noop_200(self, client):
+        client.deployments.create(_deploy("noop"))
+        out = client.deployments.patch("noop", [], "default",
+                                       patch_type="json")
+        assert out["spec"]["replicas"] == 2
