@@ -212,6 +212,7 @@ class EndpointSliceController(Controller):
 TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
 TAINT_NOT_READY = "node.kubernetes.io/not-ready"
 TAINT_MEMORY_PRESSURE = "node.kubernetes.io/memory-pressure"
+TAINT_DISK_PRESSURE = "node.kubernetes.io/disk-pressure"
 
 
 class NodeLifecycleController(Controller):
@@ -293,25 +294,31 @@ class NodeLifecycleController(Controller):
         self._sync_pressure_taint(node)
 
     def _sync_pressure_taint(self, node: Dict) -> None:
-        """TaintNodesByCondition: the MemoryPressure condition the kubelet's
-        eviction manager reports becomes the NoSchedule taint
-        `node.kubernetes.io/memory-pressure` — the scheduler's taint filter
-        then repels new pods without any scheduler-side special case."""
-        pressure = any(
-            c.get("type") == "MemoryPressure" and c.get("status") == "True"
-            for c in node.get("status", {}).get("conditions", []))
+        """TaintNodesByCondition: the MemoryPressure / DiskPressure
+        conditions the kubelet's eviction manager reports become the
+        NoSchedule taints `node.kubernetes.io/{memory,disk}-pressure` —
+        the scheduler's taint filter then repels new pods without any
+        scheduler-side special case."""
+        conds = node.get("status", {}).get("conditions", [])
+        want = {}
+        for cond_type, taint_key in (("MemoryPressure",
+                                      TAINT_MEMORY_PRESSURE),
+                                     ("DiskPressure", TAINT_DISK_PRESSURE)):
+            want[taint_key] = any(
+                c.get("type") == cond_type and c.get("status") == "True"
+                for c in conds)
         taints = list(node.get("spec", {}).get("taints", []) or [])
-        has = any(t.get("key") == TAINT_MEMORY_PRESSURE for t in taints)
-        if pressure == has:
+        has = {k: any(t.get("key") == k for t in taints) for k in want}
+        if want == has:
             return
 
         def update():
             cur = self.client.nodes.get(meta.name(node), "")
             cur_taints = [t for t in cur.get("spec", {}).get("taints", [])
-                          or [] if t.get("key") != TAINT_MEMORY_PRESSURE]
-            if pressure:
-                cur_taints.append({"key": TAINT_MEMORY_PRESSURE,
-                                   "effect": "NoSchedule"})
+                          or [] if t.get("key") not in want]
+            for key, on in want.items():
+                if on:
+                    cur_taints.append({"key": key, "effect": "NoSchedule"})
             cur.setdefault("spec", {})["taints"] = cur_taints
             self.client.nodes.update(cur, "")
 

@@ -147,6 +147,18 @@ class ImageGCManager:
         self.clock = clock or getattr(cri, "clock", time.monotonic)
         self.last_freed_bytes = 0
 
+    def delete_unused_images(self) -> int:
+        """Delete EVERY unused image regardless of thresholds — what the
+        eviction manager's reclaimNodeLevelResources calls
+        (eviction_manager.go → imageGC.DeleteUnusedImages). Returns bytes
+        freed."""
+        freed = 0
+        for img in self.cri.list_images():
+            if not img.get("inUse"):
+                self.cri.remove_image(img["name"])
+                freed += int(img.get("sizeBytes", 0))
+        return freed
+
     def garbage_collect(self) -> int:
         """One GC pass; returns bytes freed (0 when below the high mark)."""
         fs = self.cri.image_fs_info()

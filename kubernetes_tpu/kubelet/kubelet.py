@@ -41,6 +41,8 @@ class Kubelet:
                  housekeeping_interval: float = 0.5,
                  checkpoint_dir: Optional[str] = None,
                  eviction_hard: Optional[Dict[str, str]] = None,
+                 eviction_soft: Optional[Dict[str, str]] = None,
+                 eviction_soft_grace_period: Optional[Dict[str, str]] = None,
                  system_reserved: Optional[Dict[str, str]] = None,
                  kube_reserved: Optional[Dict[str, str]] = None,
                  image_gc_high_percent: int = 85,
@@ -87,11 +89,18 @@ class Kubelet:
         # the threshold, MemoryPressure goes True (+ NoSchedule taint) and
         # pods are evicted lowest-priority-first until below threshold
         self.eviction_hard = dict(eviction_hard or {})
+        # soft thresholds must hold CONTINUOUSLY for their grace period
+        # before acting (eviction/helpers.go thresholdsMetGracePeriod);
+        # observation start times live in _soft_observed_since
+        self.eviction_soft = dict(eviction_soft or {})
+        self.eviction_soft_grace = dict(eviction_soft_grace_period or {})
+        self._soft_observed_since: Dict[str, float] = {}
         self.under_memory_pressure = False
+        self.under_disk_pressure = False
         # uids this kubelet evicted: blocks resync-resurrection while the
         # Failed status propagates through the watch (cleared at teardown)
         self._evicted: set = set()
-        self._pending_evict_writes: Dict[str, Obj] = {}
+        self._pending_evict_writes: Dict[str, tuple] = {}  # uid → (pod, res)
         # container manager (kubelet/cm.py): node allocatable = capacity -
         # reservations, and the canAdmitPod gate _sync_pod runs before a
         # sandbox exists. Rejected uids behave like evicted ones: no
@@ -140,9 +149,11 @@ class Kubelet:
         try:
             node = self.client.nodes.get(self.node_name, "")
             conds = [c for c in node.get("status", {}).get("conditions", [])
-                     if c.get("type") not in ("Ready", "MemoryPressure")]
+                     if c.get("type") not in ("Ready", "MemoryPressure",
+                                              "DiskPressure")]
             conds.append(self._ready_condition())
-            if self.eviction_hard:
+            thresholds = {**self.eviction_hard, **self.eviction_soft}
+            if "memory.available" in thresholds:
                 # the eviction manager's verdict rides the heartbeat
                 # (kubelet_node_status.go setNodeMemoryPressureCondition)
                 conds.append({
@@ -152,6 +163,14 @@ class Kubelet:
                     "reason": "KubeletHasInsufficientMemory"
                     if self.under_memory_pressure
                     else "KubeletHasSufficientMemory"})
+            if "nodefs.available" in thresholds:
+                conds.append({
+                    "type": "DiskPressure",
+                    "status": "True" if self.under_disk_pressure
+                    else "False",
+                    "reason": "KubeletHasDiskPressure"
+                    if self.under_disk_pressure
+                    else "KubeletHasNoDiskPressure"})
             node.setdefault("status", {})["conditions"] = conds
             node["status"]["capacity"] = dict(self.capacity)
             node["status"]["allocatable"] = \
@@ -230,8 +249,8 @@ class Kubelet:
                     evict_writes = list(self._pending_evict_writes.items())
                 for pod in parked:
                     self._pod_deleted(pod)
-                for uid, pod in evict_writes:
-                    if self._write_evicted_status(pod):
+                for uid, (pod, resource) in evict_writes:
+                    if self._write_evicted_status(pod, resource):
                         with self._pod_mu:
                             self._pending_evict_writes.pop(uid, None)
                 with self._pod_mu:
@@ -241,7 +260,7 @@ class Kubelet:
                     if self._write_failed_status(pod, reason, message):
                         with self._pod_mu:
                             self._pending_reject_writes.pop(uid, None)
-                if self.eviction_hard:
+                if self.eviction_hard or self.eviction_soft:
                     self._check_eviction()
                 now = self.clock()
                 if now - self._last_image_gc >= self._image_gc_period:
@@ -345,18 +364,57 @@ class Kubelet:
     # eviction manager (pkg/kubelet/eviction/eviction_manager.go)
     # ------------------------------------------------------------------ #
 
-    def _check_eviction(self) -> None:
-        """synchronize() analog: compare memory.available against the hard
-        threshold; under pressure, evict the lowest-priority / heaviest pod
-        (rankMemoryPressure: priority, then usage) and flag the condition
-        the heartbeat + taint publish. One stats snapshot feeds both the
-        availability sum and the ranking, so the verdict and the victim
-        come from the same observation."""
+    @staticmethod
+    def _parse_threshold(value: str, capacity_bytes: int) -> float:
+        """Threshold quantity: absolute ("1Gi") or percentage of capacity
+        ("10%") — both forms the reference accepts (eviction/api/types)."""
         from kubernetes_tpu.api.types import parse_mem_kib
 
-        thresh = self.eviction_hard.get("memory.available")
-        if not thresh:
-            return
+        value = str(value).strip()
+        if value.endswith("%"):
+            return capacity_bytes * float(value[:-1]) / 100.0
+        return parse_mem_kib(value) * 1024.0
+
+    @staticmethod
+    def _parse_grace(value: str) -> float:
+        """Duration string: '90s', '1m30s', '2h' (metav1.Duration subset)."""
+        import re as _re
+
+        total = 0.0
+        for num, unit in _re.findall(r"([0-9.]+)(h|m|s|ms)", str(value)):
+            total += float(num) * {"h": 3600.0, "m": 60.0, "s": 1.0,
+                                   "ms": 0.001}[unit]
+        return total
+
+    def _signal_under_pressure(self, signal: str, avail: float,
+                               cap: float, now: float) -> bool:
+        """Hard threshold: immediate. Soft threshold: only after holding
+        continuously for its grace period."""
+        hard = self.eviction_hard.get(signal)
+        if hard and avail < self._parse_threshold(hard, int(cap)):
+            return True
+        soft = self.eviction_soft.get(signal)
+        if soft and avail < self._parse_threshold(soft, int(cap)):
+            since = self._soft_observed_since.setdefault(signal, now)
+            grace = self._parse_grace(
+                self.eviction_soft_grace.get(signal, "0s"))
+            return now - since >= grace
+        self._soft_observed_since.pop(signal, None)
+        return False
+
+    def _check_eviction(self) -> None:
+        """synchronize() analog over two signals: memory.available (CRI
+        container stats) and nodefs.available (imagefs). Under memory
+        pressure, evict the rankMemoryPressure victim; under disk
+        pressure, reclaim node-level resources FIRST (delete unused
+        images — eviction_manager.go reclaimNodeLevelResources) and evict
+        only if that does not clear the signal. Conditions ride the
+        heartbeat; nodelifecycle converts them to NoSchedule taints. One
+        stats snapshot feeds both the availability sum and the ranking,
+        so the verdict and the victim come from the same observation."""
+        from kubernetes_tpu.api.types import parse_mem_kib
+
+        now = self.clock()
         with self._pod_mu:
             uids = set(self._sandbox_by_uid)
         usage: Dict[str, int] = {}
@@ -366,9 +424,42 @@ class Kubelet:
                 usage[uid] = usage.get(uid, 0) + s["memoryBytes"]
         cap_b = parse_mem_kib(self.capacity.get("memory", "0")) * 1024
         avail = cap_b - sum(usage.values())
-        pressure = avail < parse_mem_kib(thresh) * 1024
-        self.under_memory_pressure = pressure
-        if not pressure:
+
+        # nodefs.available over the image filesystem (the only fs here)
+        disk_signals = ("nodefs.available" in self.eviction_hard
+                        or "nodefs.available" in self.eviction_soft)
+        if disk_signals:
+            try:
+                fs = self.cri.image_fs_info()
+            except Exception:  # noqa: BLE001 — runtime down: skip this tick
+                fs = None
+            if fs is not None:
+                fs_cap = int(fs.get("capacityBytes", 0))
+                fs_avail = fs_cap - int(fs.get("usedBytes", 0))
+                under_disk = self._signal_under_pressure(
+                    "nodefs.available", fs_avail, fs_cap, now)
+                if under_disk:
+                    # reclaim node-level resources first: delete unused
+                    # images, then re-measure before evicting anything
+                    self.image_gc.delete_unused_images()
+                    fs = self.cri.image_fs_info()
+                    fs_avail = int(fs.get("capacityBytes", 0)) - \
+                        int(fs.get("usedBytes", 0))
+                    under_disk = self._signal_under_pressure(
+                        "nodefs.available", fs_avail, fs_cap, now)
+                self.under_disk_pressure = under_disk
+
+        mem_pressure = self._signal_under_pressure(
+            "memory.available", avail, cap_b, now)
+        self.under_memory_pressure = mem_pressure
+        if mem_pressure:
+            starved = "memory"
+        elif self.under_disk_pressure:
+            # disk pressure unresolved by image reclaim: evict one pod.
+            # FakeCRI models no per-pod disk usage (PARITY #9b), so the
+            # memory ranking below doubles as the disk ranking.
+            starved = "ephemeral-storage"
+        else:
             return
         from kubernetes_tpu.kubelet.cm import pod_requests
 
@@ -393,9 +484,9 @@ class Kubelet:
         # key excludes the pod dict: rank ties must not fall through to
         # (unorderable) dict comparison
         victims.sort(key=lambda v: v[:4])
-        self._evict_pod(victims[0][4])
+        self._evict_pod(victims[0][4], resource=starved)
 
-    def _evict_pod(self, pod: Obj) -> None:
+    def _evict_pod(self, pod: Obj, resource: str = "memory") -> None:
         """Kill the pod's containers and report Failed/Evicted — the
         reference's evictPod (the object survives in Failed state; a
         controller replaces it elsewhere). The uid is marked evicted so a
@@ -417,16 +508,17 @@ class Kubelet:
                 self.cri.remove_pod_sandbox(sid)
             except CRIError:
                 pass
-        if not self._write_evicted_status(pod):
+        if not self._write_evicted_status(pod, resource):
             # parked: the housekeeping loop re-drives the write until it
             # lands — the sandbox is already gone, so the pod must not be
             # left reporting Running forever
             with self._pod_mu:
-                self._pending_evict_writes[meta.uid(pod)] = pod
+                self._pending_evict_writes[meta.uid(pod)] = (pod, resource)
 
-    def _write_evicted_status(self, pod: Obj) -> bool:
+    def _write_evicted_status(self, pod: Obj,
+                              resource: str = "memory") -> bool:
         return self._write_failed_status(
-            pod, "Evicted", "The node was low on resource: memory.")
+            pod, "Evicted", f"The node was low on resource: {resource}.")
 
     def _write_failed_status(self, pod: Obj, reason: str,
                              message: str) -> bool:

