@@ -522,11 +522,15 @@ def _handle_rest_inner(api: APIServer, method: str, path: str,
     parts = [p for p in path.split("/") if p]
     if not parts:
         return 200, {"paths": ["/api", "/apis", "/healthz", "/metrics",
-                               "/version"]}
+                               "/openapi/v2", "/version"]}
 
     # non-resource endpoints
     if parts[0] in ("healthz", "readyz", "livez"):
         return 200, "ok"
+    if parts[0] == "openapi":
+        from kubernetes_tpu.apiserver.openapi import build_openapi
+
+        return 200, build_openapi(api)
     if parts[0] == "metrics":
         from kubernetes_tpu.component.metrics import DEFAULT_REGISTRY
 

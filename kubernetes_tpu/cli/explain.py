@@ -155,10 +155,21 @@ _TREE: Dict[str, Node] = {
 
 
 def _from_openapi(schema: Dict[str, Any], doc: str = "") -> Node:
-    """Lift a CRD openAPIV3Schema subtree into a doc node."""
+    """Lift an OpenAPI schema subtree (CRD openAPIV3Schema, or a served
+    /openapi/v2 definition) into a doc node. Arrays descend into items so
+    `pods.spec.containers.resources` keeps walking."""
+    typ = schema.get("type", "Object")
+    if typ == "array":
+        items = schema.get("items") or {}
+        inner = _from_openapi(items)
+        return {
+            "doc": schema.get("description", doc) or inner["doc"],
+            "type": f"[]{inner['type']}",
+            "fields": inner["fields"],
+        }
     return {
         "doc": schema.get("description", doc) or "<no description>",
-        "type": schema.get("type", "Object"),
+        "type": typ,
         "fields": {k: _from_openapi(v)
                    for k, v in (schema.get("properties") or {}).items()},
     }
@@ -166,11 +177,16 @@ def _from_openapi(schema: Dict[str, Any], doc: str = "") -> Node:
 
 def explain_text(resource: str, group: str, version: str,
                  field_path: List[str],
-                 crd_schema: Optional[Dict[str, Any]] = None
+                 crd_schema: Optional[Dict[str, Any]] = None,
+                 node: Optional[Node] = None,
                  ) -> Optional[str]:
     """Render the explain output for `resource[.field...]`, or None if the
-    path does not resolve."""
-    if crd_schema is not None:
+    path does not resolve. `node` carries a pre-resolved doc tree (the
+    served-OpenAPI path); crd_schema lifts a raw openAPIV3Schema; the
+    built-in tree is the in-process fallback."""
+    if node is not None:
+        pass
+    elif crd_schema is not None:
         node = _from_openapi(crd_schema, f"Custom resource {resource}")
         node["fields"].setdefault("metadata", _META)
     else:

@@ -408,15 +408,32 @@ class Kubectl:
 
     def explain(self, path: str) -> int:
         """kubectl explain (staging/src/k8s.io/kubectl/pkg/cmd/explain):
-        walk a dotted field path through the resource's schema docs —
-        built-in docs for core kinds, the CRD's openAPIV3Schema for custom
-        resources (the reference walks the server's OpenAPI document)."""
-        from kubernetes_tpu.cli.explain import explain_text
+        walk a dotted field path through the SERVED OpenAPI document
+        (/openapi/v2 — the same walk the reference does), falling back to
+        the in-process doc trees only if the server has no /openapi/v2."""
+        from kubernetes_tpu.cli.explain import (
+            _META, _from_openapi, explain_text)
 
         segs = path.split(".")
         rc = self._rc(segs[0])
-        crd_schema = None
-        if rc.group not in ("", "apps", "batch", "policy"):
+        node = None
+        try:
+            doc = self.client.transport.request("GET", "/openapi/v2",
+                                                {}, None)
+        except Exception:  # noqa: BLE001 — older server: in-process docs
+            doc = None
+        if isinstance(doc, dict) and doc.get("definitions"):
+            from kubernetes_tpu.apiserver.openapi import find_definition
+
+            schema = find_definition(doc, rc.group, rc.version,
+                                     resource=rc.resource)
+            if schema is not None:
+                node = _from_openapi(schema)
+                node["fields"].setdefault("metadata", _META)
+        if node is None and rc.group not in ("", "apps", "batch", "policy"):
+            # find_definition's kind→plural match is naive (irregular
+            # plurals miss), and an older server may serve no /openapi/v2
+            # at all: fetch the CRD's schema by its exact stored name
             try:
                 crd = self.client.customresourcedefinitions.get(
                     f"{rc.resource}.{rc.group}", "")
@@ -428,10 +445,14 @@ class Kubectl:
                     "openAPIV3Schema") or (crd.get("spec", {})
                                            .get("validation") or {}).get(
                                                "openAPIV3Schema")
+                if crd_schema is not None:
+                    node = _from_openapi(
+                        crd_schema, f"Custom resource {rc.resource}")
+                    node["fields"].setdefault("metadata", _META)
             except errors.StatusError:
                 pass
         text = explain_text(rc.resource, rc.group, rc.version, segs[1:],
-                            crd_schema)
+                            node=node)
         if text is None:
             self.err.write(f"error: field {'.'.join(segs)!r} does not "
                            "exist\n")

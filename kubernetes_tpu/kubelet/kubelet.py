@@ -381,7 +381,8 @@ class Kubelet:
         import re as _re
 
         total = 0.0
-        for num, unit in _re.findall(r"([0-9.]+)(h|m|s|ms)", str(value)):
+        # ms before m/s: the alternation is first-match (500ms ≠ 500 min)
+        for num, unit in _re.findall(r"([0-9.]+)(ms|h|m|s)", str(value)):
             total += float(num) * {"h": 3600.0, "m": 60.0, "s": 1.0,
                                    "ms": 0.001}[unit]
         return total
@@ -440,14 +441,22 @@ class Kubelet:
                     "nodefs.available", fs_avail, fs_cap, now)
                 if under_disk:
                     # reclaim node-level resources first: delete unused
-                    # images, then re-measure before evicting anything
-                    self.image_gc.delete_unused_images()
-                    fs = self.cri.image_fs_info()
-                    fs_avail = int(fs.get("capacityBytes", 0)) - \
-                        int(fs.get("usedBytes", 0))
-                    under_disk = self._signal_under_pressure(
-                        "nodefs.available", fs_avail, fs_cap, now)
-                self.under_disk_pressure = under_disk
+                    # images, then re-measure before evicting anything.
+                    # Same runtime-down policy as the first probe: a
+                    # CRIError mid-reclaim skips the DISK verdict for this
+                    # tick but must not abort the memory check below.
+                    try:
+                        self.image_gc.delete_unused_images()
+                        fs = self.cri.image_fs_info()
+                        fs_avail = int(fs.get("capacityBytes", 0)) - \
+                            int(fs.get("usedBytes", 0))
+                        under_disk = self._signal_under_pressure(
+                            "nodefs.available", fs_avail, fs_cap, now)
+                        self.under_disk_pressure = under_disk
+                    except Exception:  # noqa: BLE001
+                        pass
+                else:
+                    self.under_disk_pressure = under_disk
 
         mem_pressure = self._signal_under_pressure(
             "memory.available", avail, cap_b, now)

@@ -218,6 +218,7 @@ class Proxier:
         with self._pending_mu:
             pending, self._pending = self._pending, set()
         n = 0
+        hc_changed = False
         for key in pending:
             ns, name = meta.split_key(key)
             svc = self.svc_informer.lister.get(ns, name)
@@ -227,9 +228,8 @@ class Proxier:
                 # the deleted service's healthCheckNodePort listener must
                 # close too, or an external LB keeps getting 200s for a
                 # service that no longer exists
-                if self._hc_state.pop((ns, name), None) is not None \
-                        and self.health_server is not None:
-                    self.health_server.sync(dict(self._hc_state))
+                hc_changed |= self._hc_state.pop((ns, name), None) \
+                    is not None
                 n += 1
                 continue
             ep = self.ep_informer.lister.get(ns, name)
@@ -268,9 +268,16 @@ class Proxier:
                 local_counts[pname] = local
             self.table.replace_service(ns, name, rules)
             self._conntrack_reconcile(ns, name, rules)
-            self._healthcheck_reconcile(ns, name, svc, local_counts)
+            hc_changed |= self._healthcheck_reconcile(ns, name, svc,
+                                                      local_counts)
             n += 1
-        if n and self.healthz is not None:
+        if hc_changed and self.health_server is not None:
+            # one listener reconcile per PASS, not per service
+            self.health_server.sync(dict(self._hc_state))
+        if self.healthz is not None:
+            # every completed pass counts — an idle proxier with nothing
+            # to program is healthy, not "never synced" (healthcheck.go
+            # calls Updated() after each syncProxyRules)
             self.healthz.updated()
         return n
 
@@ -305,21 +312,23 @@ class Proxier:
         self._udp_state.update(new)
 
     def _healthcheck_reconcile(self, ns: str, name: str, svc: Obj,
-                               local_counts: Dict[str, int]) -> None:
+                               local_counts: Dict[str, int]) -> bool:
         """externalTrafficPolicy: Local services with a healthCheckNodePort
         get a per-service health listener reporting this node's LOCAL
         endpoint count (healthcheck.go SyncServices/SyncEndpoints). The
-        desired set lives in self._hc_state; the server just mirrors it."""
+        desired set lives in self._hc_state; the caller pushes it to the
+        server ONCE per sync pass. Returns whether this entry changed."""
         if self.health_server is None:
-            return
+            return False
         spec = svc.get("spec", {}) or {}
         hc_port = int(spec.get("healthCheckNodePort", 0) or 0)
+        old = self._hc_state.get((ns, name))
         if hc_port and spec.get("externalTrafficPolicy") == "Local":
-            self._hc_state[(ns, name)] = (hc_port,
-                                          sum(local_counts.values()))
-        else:
-            self._hc_state.pop((ns, name), None)
-        self.health_server.sync(dict(self._hc_state))
+            new = (hc_port, sum(local_counts.values()))
+            self._hc_state[(ns, name)] = new
+            return old != new
+        self._hc_state.pop((ns, name), None)
+        return old is not None
 
     def sync_all(self) -> int:
         for svc in self.svc_informer.lister.list():

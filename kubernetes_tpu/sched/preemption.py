@@ -56,6 +56,35 @@ class CacheEvictor:
         return True
 
 
+class APIEvictor(CacheEvictor):
+    """Live-cluster evictor: DELETE the victim through the API (the
+    reference's generic_scheduler.go:352-364 pod deletes), then drop it
+    from the cache optimistically — the informer's delete event is the
+    authoritative confirmation. A victim that is already gone counts as
+    evicted; any other API failure leaves the cache untouched so the
+    what-if's arithmetic never diverges from the real world."""
+
+    def __init__(self, client) -> None:
+        super().__init__()
+        self.client = client
+
+    def evict(self, scheduler, victim_key: str) -> bool:
+        from ..machinery import errors
+
+        pod = scheduler.cache.get_pod(victim_key)
+        if pod is None:
+            return False
+        ns, _, name = victim_key.partition("/")
+        try:
+            self.client.pods.delete(name, ns)
+        except errors.StatusError as e:
+            if not errors.is_not_found(e):
+                return False
+        scheduler.cache.remove_pod(victim_key)
+        self.evicted.append(victim_key)
+        return True
+
+
 class Preemptor:
     def __init__(self, evictor: Optional[CacheEvictor] = None,
                  pdb_source: Optional[Callable[[], list]] = None) -> None:

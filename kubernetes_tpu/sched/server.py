@@ -86,7 +86,8 @@ class SchedulerServer:
                  batch_window: float = 0.02,
                  leader_elect: bool = False,
                  volume_binding: bool = True,
-                 config=None):
+                 config=None,
+                 base_dims=None):
         from kubernetes_tpu.state.dims import Dims
 
         # ComponentConfig / Policy surface (apis/config/types.go:45-112 →
@@ -130,8 +131,10 @@ class SchedulerServer:
             framework=framework,
             extenders=extenders,
             # shape floor: tiny waves share one compiled (P,N,E) signature
-            # instead of recompiling at every power-of-two batch size
-            base_dims=Dims(N=64, P=128, E=512))
+            # instead of recompiling at every power-of-two batch size; a
+            # caller expecting a large cluster pre-sizes (capacity
+            # provisioning — avoids growth-bucket recompiles mid-flight)
+            base_dims=base_dims or Dims(N=64, P=128, E=512))
         if self.scheduler.binder is None:
             self.scheduler.binder = APIBinder(client)
         if self.config is not None:
@@ -151,16 +154,21 @@ class SchedulerServer:
                     pl._absent_ids = tuple(keys.intern(k) for k in pl.absent)
         if scheduler is None and (self.config is None or
                                   not self.config.disable_preemption):
-            from kubernetes_tpu.sched.preemption import Preemptor
+            from kubernetes_tpu.sched.preemption import APIEvictor, Preemptor
 
             # preemption is ON by default — DisablePreemption defaults
             # false (apis/config/types.go:76); only an explicit
             # disablePreemption: true (or a caller-built Scheduler) turns
-            # it off. PDB lister for the preemption what-if
-            # (filterPodsWithPDBViolation inputs) — served from the PDB
-            # informer cache wired in start(), like the reference's policy
-            # lister, never a synchronous LIST on the preemption hot path
+            # it off. Victims are evicted THROUGH THE API (APIEvictor) —
+            # the cache-only default evictor would free resources the
+            # scheduler sees while the victim pod lives on in the
+            # apiserver, double-booking its node. PDB lister for the
+            # preemption what-if (filterPodsWithPDBViolation inputs) —
+            # served from the PDB informer cache wired in start(), like
+            # the reference's policy lister, never a synchronous LIST on
+            # the preemption hot path
             self.scheduler.preemptor = Preemptor(
+                evictor=APIEvictor(client),
                 pdb_source=lambda: list(self._pdb_cache.values()))
         self.cycle_interval = cycle_interval
         # debounce: when pods flood in, wait this long so one batched device

@@ -336,3 +336,62 @@ def test_reprieve_conservatism_vs_oracle():
     # scan evicted SOMETHING across the trials
     assert total_evictions > 0
     assert extra_evictions <= total_evictions
+
+
+def test_server_preemption_deletes_victim_through_api():
+    """Round-5 regression (found by the scheduler-in-the-loop bench): the
+    SchedulerServer's preemptor must evict THROUGH THE API. The cache-only
+    evictor freed resources in the scheduler's head while the victim pod
+    lived on in the apiserver — the preemptor pod then bound onto a node
+    whose real occupant was never removed (double-booking)."""
+    import time
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import Client
+    from kubernetes_tpu.machinery import errors as merrors
+    from kubernetes_tpu.sched.server import SchedulerServer
+
+    api = APIServer()
+    client = Client.local(api)
+    caps = {"capacity": {"cpu": "4", "memory": "8Gi", "pods": "10"},
+            "allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"}}
+    client.nodes.create({"apiVersion": "v1", "kind": "Node",
+                         "metadata": {"name": "only",
+                                      "labels": {"pin": "y"}},
+                         "status": caps})
+    server = SchedulerServer(client, cycle_interval=0.02,
+                             batch_window=0.02).start()
+    try:
+        client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "squatter", "namespace": "default"},
+            "spec": {"nodeName": "only", "priority": 0,
+                     "containers": [{"name": "c", "image": "i",
+                                     "resources": {"requests": {
+                                         "cpu": "3500m",
+                                         "memory": "6Gi"}}}]}})
+        time.sleep(0.5)
+        client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "vip", "namespace": "default"},
+            "spec": {"priority": 1000, "nodeSelector": {"pin": "y"},
+                     "containers": [{"name": "c", "image": "i",
+                                     "resources": {"requests": {
+                                         "cpu": "3", "memory": "4Gi"}}}]}})
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if client.pods.get("vip").get("spec", {}).get("nodeName"):
+                break
+            time.sleep(0.1)
+        assert client.pods.get("vip")["spec"]["nodeName"] == "only"
+        # the victim is REALLY gone from the API, not just the cache
+        try:
+            sq = client.pods.get("squatter")
+            assert sq.get("metadata", {}).get("deletionTimestamp") or \
+                sq.get("status", {}).get("phase") == "Failed", \
+                f"squatter survived: {sq.get('status')}"
+        except merrors.StatusError as e:
+            assert merrors.is_not_found(e)
+    finally:
+        server.stop()
+        api.close()
