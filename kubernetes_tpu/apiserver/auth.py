@@ -27,10 +27,14 @@ ANONYMOUS = UserInfo("system:anonymous", ("system:unauthenticated",))
 
 
 class TokenAuthenticator:
-    """Static token file analog (--token-auth-file)."""
+    """Static token file analog (--token-auth-file), optionally chained
+    with token authenticators consulted on a static-map miss — the
+    union authenticator seat (bootstrap tokens plug in here,
+    plugin/pkg/auth/authenticator/token/bootstrap)."""
 
     def __init__(self, tokens: Optional[Dict[str, UserInfo]] = None):
         self.tokens = dict(tokens or {})
+        self.chain: list = []  # objects with authenticate(token) → UserInfo|None
 
     def add(self, token: str, user: str, groups: Tuple[str, ...] = ()) -> None:
         self.tokens[token] = UserInfo(user, tuple(groups) +
@@ -40,9 +44,14 @@ class TokenAuthenticator:
         auth = headers.get("Authorization", "") or headers.get(
             "authorization", "")
         if auth.startswith("Bearer "):
-            user = self.tokens.get(auth[7:])
+            token = auth[7:]
+            user = self.tokens.get(token)
             if user is not None:
                 return user
+            for delegate in self.chain:
+                user = delegate.authenticate(token)
+                if user is not None:
+                    return user
             raise errors.new_unauthorized("invalid bearer token")
         return ANONYMOUS
 
@@ -183,10 +192,14 @@ class AuthGate:
     def __init__(self, authenticator: Optional[TokenAuthenticator] = None,
                  authorizer: Optional[RBACAuthorizer] = None,
                  always_allow_paths: Tuple[str, ...] = ("/healthz", "/readyz",
-                                                        "/livez", "/version")):
+                                                        "/livez", "/version"),
+                 allow_anonymous: bool = True):
         self.authenticator = authenticator
         self.authorizer = authorizer
         self.always_allow_paths = always_allow_paths
+        # --anonymous-auth=false: credential-less requests are 401s rather
+        # than the system:anonymous identity
+        self.allow_anonymous = allow_anonymous
 
     def check(self, method: str, path: str, query: Dict[str, str],
               headers: Dict[str, str]) -> str:
@@ -198,6 +211,9 @@ class AuthGate:
         if path in self.always_allow_paths:
             return ""
         user = self.authenticator.authenticate(headers)
+        if not self.allow_anonymous and user is ANONYMOUS:
+            raise errors.new_unauthorized(
+                "anonymous requests are disabled")
         if self.authorizer is None:
             return user.name
         attrs = attributes_from_request(user, method, path, query)

@@ -188,6 +188,16 @@ class Store:
                 merged["status"] = new.get("status", {})
                 merged["metadata"] = cm
                 new = merged
+            elif subresource == "approval":
+                # CSR approval touches ONLY status.conditions (registry/
+                # certificates approval strategy): an approval built from a
+                # stale read must not wipe an issued status.certificate,
+                # and approval callers must not inject one
+                merged = meta.deep_copy(cur)
+                merged.setdefault("status", {})["conditions"] = \
+                    (new.get("status", {}) or {}).get("conditions", [])
+                merged["metadata"] = cm
+                new = merged
             elif subresource == "":
                 # spec updates keep status (registry strategy PrepareForUpdate)
                 if "status" in cur and "status" not in new:

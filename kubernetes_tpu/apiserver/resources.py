@@ -296,6 +296,13 @@ def build_scheme() -> Scheme:
     s.register(R("rbac.authorization.k8s.io", "v1", "ClusterRoleBinding",
                  "clusterrolebindings", namespaced=False))
 
+    # ---- certificates (the kubelet credential path:
+    # pkg/apis/certificates, CSR create → approve → sign) ----
+    s.register(R("certificates.k8s.io", "v1beta1",
+                 "CertificateSigningRequest", "certificatesigningrequests",
+                 namespaced=False, short_names=("csr",),
+                 subresources=("status", "approval")))
+
     # ---- apiextensions (CRD registration; dynamic install handled by the
     # server's CRD hook) ----
     s.register(R("apiextensions.k8s.io", "v1", "CustomResourceDefinition",

@@ -615,6 +615,14 @@ def _handle_rest_inner(api: APIServer, method: str, path: str,
                                           body or {})
         if sub == "finalize" and info.resource == "namespaces" and method == "PUT":
             return 200, api.finalize_namespace(name, body or {})
+        if sub == "approval" and info.resource == "certificatesigningrequests" \
+                and method == "PUT":
+            # CSR approval (pkg/registry/certificates approval REST): the
+            # body is the CSR carrying Approved/Denied conditions; only
+            # status.conditions lands (spec + certificate untouched —
+            # enforced by the registry's approval strategy)
+            return 200, st.update(namespace, name, body or {},
+                                  subresource="approval")
         if sub == "status":
             if method == "GET":
                 return 200, st.get(namespace, name)

@@ -40,6 +40,11 @@ class ClusterConfig:
         "cpu": "8", "memory": "16Gi", "pods": "110"})
     leader_elect: bool = False
     controllers: Optional[List[str]] = None
+    # authenticated=True puts an AuthGate on the gateway: components use a
+    # minted admin token, and joiners' bootstrap tokens are VALIDATED by
+    # the BootstrapTokenAuthenticator chain (the kubeadm topology; off by
+    # default to keep the open integration-test surface)
+    authenticated: bool = False
     scheduler_name: str = "default-scheduler"
     # KubeSchedulerConfiguration: a path, YAML/JSON string, or dict
     # (sched/config.py load_config) — the kube-scheduler --config analog
@@ -88,15 +93,35 @@ class Cluster:
         self.manager: Optional[ControllerManager] = None
         self.hollow: Optional[HollowCluster] = None
         self._joined: List[HollowCluster] = []
+        self.bootstrap_token: str = ""
+        self.admin_token: str = ""
+        self.node_credentials: Dict[str, Dict[str, bytes]] = {}
 
     # -- phases (kubeadm init workflow) ------------------------------------- #
 
     def up(self) -> "Cluster":
         cfg = self.config
         self.api = APIServer()
-        self.gateway = HTTPGateway(self.api, host=cfg.host,
-                                   port=cfg.port).start()
-        self.client = Client.http(self.gateway.url)
+        auth_gate = None
+        self.admin_token = ""
+        if cfg.authenticated:
+            import secrets as pysecrets
+
+            from kubernetes_tpu.apiserver.auth import (
+                AuthGate, TokenAuthenticator)
+            from kubernetes_tpu.controllers.certificates import (
+                BootstrapTokenAuthenticator)
+
+            self.admin_token = pysecrets.token_hex(16)
+            ta = TokenAuthenticator()
+            ta.add(self.admin_token, "kubernetes-admin",
+                   ("system:masters",))
+            ta.chain.append(BootstrapTokenAuthenticator(self.api))
+            auth_gate = AuthGate(authenticator=ta, allow_anonymous=False)
+        self.gateway = HTTPGateway(self.api, host=cfg.host, port=cfg.port,
+                                   auth_gate=auth_gate).start()
+        self.client = Client.http(self.gateway.url,
+                                  token=self.admin_token)
         self.scheduler = SchedulerServer(
             self.client,
             scheduler_name=cfg.scheduler_name,
@@ -105,6 +130,19 @@ class Cluster:
         self.manager = ControllerManager(
             self.client, controllers=cfg.controllers,
             leader_elect=cfg.leader_elect).start()
+        # bootstrap-token phase (kubeadm init phase bootstrap-token): mint
+        # the token joiners authenticate with; the CSR controllers serve
+        # the other half of TLS bootstrap
+        from kubernetes_tpu.controllers.certificates import (
+            make_bootstrap_token)
+        from kubernetes_tpu.machinery import errors as merrors
+
+        self.bootstrap_token, secret = make_bootstrap_token()
+        try:
+            self.client.secrets.create(secret, "kube-system")
+        except merrors.StatusError as e:
+            if not merrors.is_already_exists(e):
+                raise
         if cfg.hollow_nodes:
             self.hollow = HollowCluster(
                 self.client, cfg.hollow_nodes,
@@ -113,17 +151,43 @@ class Cluster:
 
     def join(self, n_nodes: int = 1, name_prefix: Optional[str] = None,
              capacity: Optional[Dict[str, str]] = None) -> "HollowCluster":
-        """kubeadm join: register n worker nodes against the running control
-        plane (a fresh client over the public URL — the same wire path an
-        out-of-process kubelet would take). Each join batch gets a unique
-        default prefix so repeated joins ADD nodes instead of re-registering
-        the previous batch's names."""
+        """kubeadm join: each worker runs TLS BOOTSTRAP first — authenticate
+        with the init-minted bootstrap token, post a node-client CSR, wait
+        for the approve/sign controllers to issue a CA-signed X.509
+        identity (phases/kubelet TLS bootstrap) — then registers against
+        the control plane over the public URL. Issued credentials land in
+        `self.node_credentials[name]` = {key, cert, ca} PEM bytes. Each
+        join batch gets a unique default prefix so repeated joins ADD
+        nodes instead of re-registering the previous batch's names."""
+        from kubernetes_tpu.controllers.certificates import (
+            BOOTSTRAP_GROUP, collect_node_identity, post_node_csr)
+
         if self.gateway is None:
             raise RuntimeError("cluster is not up")
         if name_prefix is None:
             name_prefix = f"joined-node-b{len(self._joined)}"
+        # TLS bootstrap requires the approve/sign controllers; a manager
+        # configured without them (custom controller subsets are a
+        # supported topology) joins token-only, as before
+        roster = set(self.manager.controllers) if self.manager else set()
+        if {"csrsigning", "csrapproving"} <= roster:
+            join_client = Client.http(self.gateway.url,
+                                      token=self.bootstrap_token)
+            tid = self.bootstrap_token.partition(".")[0]
+            # post every CSR first, THEN collect: the approve/sign
+            # round-trips overlap across the batch instead of serializing
+            keys = {}
+            for i in range(n_nodes):
+                name = f"{name_prefix}-{i}"
+                keys[name] = post_node_csr(
+                    join_client, name,
+                    username=f"system:bootstrap:{tid}",
+                    groups=[BOOTSTRAP_GROUP])
+            for name, key_pem in keys.items():
+                self.node_credentials[name] = collect_node_identity(
+                    join_client, name, key_pem)
         extra = HollowCluster(
-            Client.http(self.gateway.url), n_nodes,
+            Client.http(self.gateway.url, token=self.admin_token), n_nodes,
             name_prefix=name_prefix,
             capacity=capacity or self.config.hollow_capacity).start()
         self._joined.append(extra)

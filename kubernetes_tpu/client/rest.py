@@ -339,6 +339,14 @@ _KNOWN = {
     "priorityclasses": ("scheduling.k8s.io", "v1", "priorityclasses", False),
     "customresourcedefinitions": ("apiextensions.k8s.io", "v1",
                                   "customresourcedefinitions", False),
+    "roles": ("rbac.authorization.k8s.io", "v1", "roles", True),
+    "rolebindings": ("rbac.authorization.k8s.io", "v1", "rolebindings", True),
+    "clusterroles": ("rbac.authorization.k8s.io", "v1", "clusterroles",
+                     False),
+    "clusterrolebindings": ("rbac.authorization.k8s.io", "v1",
+                            "clusterrolebindings", False),
+    "certificatesigningrequests": ("certificates.k8s.io", "v1beta1",
+                                   "certificatesigningrequests", False),
 }
 
 

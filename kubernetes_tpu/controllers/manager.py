@@ -34,6 +34,11 @@ from kubernetes_tpu.controllers.autoscale import (
     NodeIpamController,
     VolumeExpansionController,
 )
+from kubernetes_tpu.controllers.certificates import (
+    ClusterRoleAggregationController,
+    CSRApprovingController,
+    CSRSigningController,
+)
 from kubernetes_tpu.controllers.workloads import (
     CronJobController,
     DaemonSetController,
@@ -66,6 +71,9 @@ DEFAULT_CONTROLLERS: Dict[str, Callable] = {
     "attachdetach": AttachDetachController,
     "volumeexpand": VolumeExpansionController,
     "nodeipam": NodeIpamController,
+    "csrsigning": CSRSigningController,
+    "csrapproving": CSRApprovingController,
+    "clusterroleaggregation": ClusterRoleAggregationController,
 }
 
 
