@@ -205,17 +205,23 @@ class AuthGate:
               headers: Dict[str, str]) -> str:
         """Raises on deny; returns the authenticated username (audit
         attribution — the reference threads user.Info through the request
-        context for exactly this)."""
+        context for exactly this). `check_info` returns the full UserInfo
+        for callers that need groups (CSR identity stamping)."""
+        info = self.check_info(method, path, query, headers)
+        return info.name if info is not None else ""
+
+    def check_info(self, method: str, path: str, query: Dict[str, str],
+                   headers: Dict[str, str]) -> Optional[UserInfo]:
         if self.authenticator is None:
-            return ""
+            return None
         if path in self.always_allow_paths:
-            return ""
+            return None
         user = self.authenticator.authenticate(headers)
         if not self.allow_anonymous and user is ANONYMOUS:
             raise errors.new_unauthorized(
                 "anonymous requests are disabled")
         if self.authorizer is None:
-            return user.name
+            return user
         attrs = attributes_from_request(user, method, path, query)
         if not self.authorizer.authorize(attrs):
             raise errors.new_forbidden(
@@ -224,4 +230,4 @@ class AuthGate:
                 f'"{attrs.resource}" in API group "{attrs.api_group}"'
                 + (f' in the namespace "{attrs.namespace}"'
                    if attrs.namespace else ""))
-        return user.name
+        return user

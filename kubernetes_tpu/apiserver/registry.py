@@ -192,10 +192,22 @@ class Store:
                 # CSR approval touches ONLY status.conditions (registry/
                 # certificates approval strategy): an approval built from a
                 # stale read must not wipe an issued status.certificate,
-                # and approval callers must not inject one
+                # approval callers must not inject one, and settled
+                # Approved/Denied verdicts are immutable — a body that
+                # drops or flips them is a 400, not a silent un-approval
+                new_conds = (new.get("status", {}) or {}).get(
+                    "conditions", []) or []
+                new_types = {c.get("type") for c in new_conds}
+                for c in (cur.get("status", {}) or {}).get(
+                        "conditions", []) or []:
+                    if c.get("type") in ("Approved", "Denied") and \
+                            c.get("type") not in new_types:
+                        raise errors.new_invalid(
+                            self.info.resource, name,
+                            f"status.conditions: Invalid value: the "
+                            f"{c.get('type')} condition cannot be removed")
                 merged = meta.deep_copy(cur)
-                merged.setdefault("status", {})["conditions"] = \
-                    (new.get("status", {}) or {}).get("conditions", [])
+                merged.setdefault("status", {})["conditions"] = new_conds
                 merged["metadata"] = cm
                 new = merged
             elif subresource == "":

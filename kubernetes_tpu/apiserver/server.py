@@ -704,8 +704,21 @@ class _Handler(BaseHTTPRequestHandler):
             user = ""
             try:
                 if auth_gate is not None:
-                    user = auth_gate.check(method, parsed.path, query,
-                                           dict(self.headers.items())) or ""
+                    uinfo = auth_gate.check_info(method, parsed.path, query,
+                                                 dict(self.headers.items()))
+                    user = uinfo.name if uinfo is not None else ""
+                    if (uinfo is not None and method == "POST"
+                            and isinstance(body, dict)
+                            and body.get("kind") ==
+                            "CertificateSigningRequest"):
+                        # the SERVER stamps the requester identity
+                        # (registry/certificates strategy
+                        # PrepareForCreate): client-claimed username/
+                        # groups are overwritten, or bootstrap-group
+                        # membership would be forgeable and the
+                        # auto-approver's trust in spec.groups unfounded
+                        body.setdefault("spec", {})["username"] = uinfo.name
+                        body["spec"]["groups"] = list(uinfo.groups)
             except errors.StatusError as e:
                 # denied requests are audited too (the reference's audit
                 # filter wraps the authorizer for exactly this)

@@ -152,8 +152,17 @@ class CSRSigningController(Controller):
 
     def __init__(self, client, factory, ca: Optional[ClusterCA] = None):
         super().__init__(client, factory)
-        self.ca = ca or _shared_ca(client)
+        # the CA resolves LAZILY on first use: csrsigning is in the default
+        # roster, and most clusters never post a CSR — RSA keygen + a
+        # Secret round-trip do not belong on every manager's startup path
+        self._ca = ca
         self.csr_informer = self.watch_resource("certificatesigningrequests")
+
+    @property
+    def ca(self) -> ClusterCA:
+        if self._ca is None:
+            self._ca = _shared_ca(self.client)
+        return self._ca
 
     #: signers this controller serves (signer.go handles only its own
     #: signerName; "" covers pre-signerName legacy-unknown requests)
@@ -169,7 +178,10 @@ class CSRSigningController(Controller):
         if csr.get("spec", {}).get("signerName", "") not in \
                 self.SIGNER_NAMES:
             return  # some other signer's request — never preempt it
-        if not _condition(csr, "Approved") or _condition(csr, "Denied"):
+        if not _condition(csr, "Approved") or _condition(csr, "Denied") \
+                or _condition(csr, "Failed"):
+            # Failed is terminal: re-signing the same malformed request
+            # would hot-loop (each status write re-enqueues via informer)
             return
         if csr.get("status", {}).get("certificate"):
             return  # already issued
@@ -353,9 +365,11 @@ class BootstrapTokenAuthenticator:
             try:
                 when = datetime.datetime.fromisoformat(
                     exp.replace("Z", "+00:00"))
+                if when.tzinfo is None:  # naive timestamps read as UTC
+                    when = when.replace(tzinfo=datetime.timezone.utc)
                 if when <= datetime.datetime.now(datetime.timezone.utc):
                     return None
-            except ValueError:
+            except (ValueError, TypeError):
                 return None
         groups = tuple(g for g in
                        data.get("auth-extra-groups", "").split(",") if g)
@@ -417,13 +431,19 @@ def post_node_csr(client, node_name: str, username: str,
     join can post every CSR first and overlap the controllers' approve/
     sign latency across nodes."""
     key_pem, csr_pem = make_node_csr(node_name)
+    obj = csr_object(f"node-csr-{node_name}", csr_pem, username, groups)
     try:
-        client.certificatesigningrequests.create(
-            csr_object(f"node-csr-{node_name}", csr_pem, username, groups),
-            "")
+        client.certificatesigningrequests.create(obj, "")
     except errors.StatusError as e:
         if not errors.is_already_exists(e):
             raise
+        # a leftover CSR belongs to a PREVIOUS key — collecting its
+        # certificate against our fresh key would hand back a mismatched
+        # pair. Re-join semantics: replace it (kubectl delete csr + retry,
+        # what kubeadm docs prescribe for re-joins).
+        client.certificatesigningrequests.delete(f"node-csr-{node_name}",
+                                                 "")
+        client.certificatesigningrequests.create(obj, "")
     return key_pem
 
 

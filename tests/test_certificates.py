@@ -302,3 +302,62 @@ class TestApprovalSubresource:
             assert not got.get("status", {}).get("certificate")
         finally:
             cm.stop()
+
+
+class TestIdentityStamping:
+    def test_server_stamps_csr_requester_identity(self):
+        """The server overwrites client-claimed spec.username/groups with
+        the AUTHENTICATED identity (registry/certificates strategy) — a
+        non-bootstrap token cannot forge system:bootstrappers membership
+        into an auto-approval."""
+        from kubernetes_tpu.apiserver.auth import (
+            AuthGate, TokenAuthenticator)
+        from kubernetes_tpu.apiserver.server import HTTPGateway
+
+        api = APIServer()
+        ta = TokenAuthenticator()
+        ta.add("user-token", "alice", ("developers",))
+        gw = HTTPGateway(api, auth_gate=AuthGate(
+            authenticator=ta, allow_anonymous=False)).start()
+        try:
+            alice = Client.http(gw.url, token="user-token")
+            _, csr_pem = make_node_csr("stolen-node")
+            forged = csr_object("forged", csr_pem,
+                                "system:bootstrap:zzz", [BOOTSTRAP_GROUP])
+            alice.certificatesigningrequests.create(forged, "")
+            got = alice.certificatesigningrequests.get("forged", "")
+            assert got["spec"]["username"] == "alice"
+            assert BOOTSTRAP_GROUP not in got["spec"]["groups"]
+        finally:
+            gw.stop()
+            api.close()
+
+    def test_rejoin_replaces_stale_csr(self, client):
+        """A re-join with a fresh key must not collect the OLD key's
+        certificate: the stale CSR is replaced."""
+        from kubernetes_tpu.controllers.certificates import post_node_csr
+
+        post_node_csr(client, "w", "u", [])
+        first = client.certificatesigningrequests.get("node-csr-w", "")
+        post_node_csr(client, "w", "u", [])
+        second = client.certificatesigningrequests.get("node-csr-w", "")
+        assert first["spec"]["request"] != second["spec"]["request"]
+
+    def test_approval_cannot_remove_settled_verdict(self, client):
+        _, csr_pem = make_node_csr("w3")
+        obj = csr_object("settled", csr_pem, "u", [])
+        client.certificatesigningrequests.create(obj, "")
+        cur = client.certificatesigningrequests.get("settled", "")
+        cur.setdefault("status", {})["conditions"] = [{"type": "Approved"}]
+        client.certificatesigningrequests.update_status(cur, "")
+        # an approval body DROPPING the Approved condition is rejected
+        from kubernetes_tpu.apiserver.server import handle_rest
+        stale = client.certificatesigningrequests.get("settled", "")
+        stale["status"]["conditions"] = []
+        stale.get("metadata", {}).pop("resourceVersion", None)
+        with pytest.raises(errors.StatusError) as ei:
+            handle_rest(client.transport.api, "PUT",
+                        "/apis/certificates.k8s.io/v1beta1/"
+                        "certificatesigningrequests/settled/approval",
+                        {}, stale)
+        assert ei.value.code == 422
