@@ -197,15 +197,27 @@ class Store:
                 # drops or flips them is a 400, not a silent un-approval
                 new_conds = (new.get("status", {}) or {}).get(
                     "conditions", []) or []
-                new_types = {c.get("type") for c in new_conds}
+                new_by_type = {c.get("type"): c for c in new_conds}
+                if "Approved" in new_by_type and "Denied" in new_by_type:
+                    raise errors.new_invalid(
+                        self.info.resource, name,
+                        "status.conditions: Invalid value: Approved and "
+                        "Denied conditions are mutually exclusive")
                 for c in (cur.get("status", {}) or {}).get(
                         "conditions", []) or []:
-                    if c.get("type") in ("Approved", "Denied") and \
-                            c.get("type") not in new_types:
+                    ctype = c.get("type")
+                    if ctype not in ("Approved", "Denied"):
+                        continue
+                    nc = new_by_type.get(ctype)
+                    if nc is None or nc.get("status", "True") != \
+                            c.get("status", "True"):
+                        # settled verdicts are immutable: neither removed
+                        # nor status-flipped (certificates validation)
                         raise errors.new_invalid(
                             self.info.resource, name,
                             f"status.conditions: Invalid value: the "
-                            f"{c.get('type')} condition cannot be removed")
+                            f"{ctype} condition cannot be removed or "
+                            f"changed")
                 merged = meta.deep_copy(cur)
                 merged.setdefault("status", {})["conditions"] = new_conds
                 merged["metadata"] = cm

@@ -27,6 +27,11 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from kubernetes_tpu.machinery import errors, meta
+# attachable-volume identity is shared with the kubelet's volume manager
+# (both sides must agree on unique volume names)
+from kubernetes_tpu.volume.names import (
+    attachable_volume_ids as _pod_attachable_volumes,
+)
 
 from .base import Controller, InformerFactory
 
@@ -201,22 +206,6 @@ class HorizontalPodAutoscalerController(Controller):
                     pass
 
 
-# well-known attachable volume source keys in v1 pod specs
-_ATTACHABLE = ("gcePersistentDisk", "awsElasticBlockStore", "rbd", "iscsi",
-               "csi")
-
-
-def _pod_attachable_volumes(pod: Dict) -> List[str]:
-    out = []
-    for v in pod.get("spec", {}).get("volumes", []) or []:
-        for k in _ATTACHABLE:
-            src = v.get(k)
-            if src:
-                vid = (src.get("pdName") or src.get("volumeID")
-                       or src.get("volumeHandle") or v.get("name", ""))
-                out.append(f"kubernetes.io/{k}/{vid}")
-                break
-    return out
 
 
 class AttachDetachController(Controller):
@@ -258,15 +247,22 @@ class AttachDetachController(Controller):
             for vid in _pod_attachable_volumes(pod):
                 if vid not in want:
                     want.append(vid)
-        attached = [{"name": v, "devicePath": ""} for v in sorted(want)]
         status = node.get("status", {})
-        if status.get("volumesAttached") == attached and \
-                status.get("volumesInUse") == sorted(want):
+        # SAFE DETACH (reconciler.go): a volume leaving the desired set
+        # stays attached while the KUBELET still reports it in
+        # volumesInUse (unmount in progress) — detaching under an active
+        # mount corrupts; volumesInUse is the kubelet's report
+        # (kubelet_node_status.go setNodeVolumesInUseStatus), not ours
+        in_use = set(status.get("volumesInUse") or [])
+        keep = sorted(set(want) | (
+            {v.get("name") for v in status.get("volumesAttached") or []}
+            & in_use))
+        attached = [{"name": v, "devicePath": ""} for v in keep]
+        if status.get("volumesAttached") == attached:
             return
         node = dict(node)
         node.setdefault("status", {})
         node["status"]["volumesAttached"] = attached
-        node["status"]["volumesInUse"] = sorted(want)
         try:
             self.client.nodes.update_status(node)
         except (errors.StatusError, AttributeError):

@@ -432,18 +432,24 @@ def post_node_csr(client, node_name: str, username: str,
     sign latency across nodes."""
     key_pem, csr_pem = make_node_csr(node_name)
     obj = csr_object(f"node-csr-{node_name}", csr_pem, username, groups)
-    try:
-        client.certificatesigningrequests.create(obj, "")
-    except errors.StatusError as e:
-        if not errors.is_already_exists(e):
-            raise
-        # a leftover CSR belongs to a PREVIOUS key — collecting its
-        # certificate against our fresh key would hand back a mismatched
-        # pair. Re-join semantics: replace it (kubectl delete csr + retry,
-        # what kubeadm docs prescribe for re-joins).
-        client.certificatesigningrequests.delete(f"node-csr-{node_name}",
-                                                 "")
-        client.certificatesigningrequests.create(obj, "")
+    for attempt in range(3):
+        try:
+            client.certificatesigningrequests.create(obj, "")
+            break
+        except errors.StatusError as e:
+            if not errors.is_already_exists(e) or attempt == 2:
+                raise
+            # a leftover CSR belongs to a PREVIOUS key — collecting its
+            # certificate against our fresh key would hand back a
+            # mismatched pair. Re-join semantics: replace it (kubectl
+            # delete csr + retry, what kubeadm prescribes for re-joins).
+            # A concurrent racer may delete first: NotFound is fine.
+            try:
+                client.certificatesigningrequests.delete(
+                    f"node-csr-{node_name}", "")
+            except errors.StatusError as de:
+                if not errors.is_not_found(de):
+                    raise
     return key_pem
 
 

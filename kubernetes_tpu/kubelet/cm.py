@@ -127,6 +127,110 @@ class ContainerManager:
         return True, "", ""
 
 
+def pod_extended_requests(pod: Obj) -> Dict[str, int]:
+    """Integer requests for non-core resources (device-plugin resources
+    like example.com/tpu, extended resources generally)."""
+    out: Dict[str, int] = {}
+    for c in (pod.get("spec", {}) or {}).get("containers", []) or []:
+        req = (c.get("resources", {}) or {}).get("requests", {}) or {}
+        for name, qty in req.items():
+            if name in ("cpu", "memory", "ephemeral-storage", "pods"):
+                continue
+            try:
+                n = int(str(qty))
+            except ValueError:
+                continue  # extended resources are integral by definition
+            if n > 0:    # negative requests are invalid — never count them
+                out[name] = out.get(name, 0) + n
+    return out
+
+
+class DevicePluginManager:
+    """The device-plugin seat (`pkg/kubelet/cm/devicemanager/manager.go`):
+    plugins register a resource name with concrete device IDs; the kubelet
+    advertises healthy counts as node capacity, admission counts requests
+    against them, and admitted containers get SPECIFIC device ids
+    allocated (the Allocate RPC) — released when the pod leaves."""
+
+    def __init__(self):
+        import threading
+
+        self._mu = threading.Lock()
+        #: resource → {device_id: healthy}
+        self._devices: Dict[str, Dict[str, bool]] = {}
+        #: pod uid → {resource: [device ids]}
+        self._allocations: Dict[str, Dict[str, List[str]]] = {}
+
+    def register(self, resource: str, device_ids: List[str]) -> None:
+        """Plugin registration (ListAndWatch's initial inventory)."""
+        with self._mu:
+            self._devices[resource] = {d: True for d in device_ids}
+
+    def set_health(self, resource: str, device_id: str,
+                   healthy: bool) -> None:
+        """A plugin reporting device health (ListAndWatch updates):
+        unhealthy devices leave capacity and are never allocated."""
+        with self._mu:
+            devs = self._devices.get(resource)
+            if devs is not None and device_id in devs:
+                devs[device_id] = healthy
+
+    def capacity(self) -> Dict[str, int]:
+        with self._mu:
+            return {res: sum(1 for ok in devs.values() if ok)
+                    for res, devs in self._devices.items()}
+
+    def _used_locked(self, resource: str) -> set:
+        return {d for alloc in self._allocations.values()
+                for d in alloc.get(resource, [])}
+
+    def available(self) -> Dict[str, int]:
+        with self._mu:
+            out = {}
+            for res, devs in self._devices.items():
+                used = self._used_locked(res)
+                out[res] = sum(1 for d, ok in devs.items()
+                               if ok and d not in used)
+            return out
+
+    def allocate(self, pod_uid: str, requests: Dict[str, int]) -> bool:
+        """Allocate concrete devices for every requested resource, or
+        nothing (all-or-nothing, as the reference's Allocate). Idempotent
+        per pod: a re-admission after a failed sync (CRIError retry path)
+        reuses the pod's existing allocation instead of counting it as
+        someone else's and spuriously rejecting."""
+        with self._mu:
+            mine = self._allocations.get(pod_uid, {})
+            plan: Dict[str, List[str]] = {}
+            for res, want in requests.items():
+                if want <= 0:
+                    continue  # negative/zero requests allocate nothing
+                if res not in self._devices:
+                    return False
+                if len(mine.get(res, [])) >= want:
+                    plan[res] = mine[res][:want]
+                    continue
+                used = self._used_locked(res) - set(mine.get(res, []))
+                free = [d for d, ok in self._devices[res].items()
+                        if ok and d not in used]
+                if len(free) < want:
+                    return False
+                plan[res] = free[:want]
+            if plan:
+                self._allocations[pod_uid] = plan
+            return True
+
+    def deallocate(self, pod_uid: str) -> None:
+        with self._mu:
+            self._allocations.pop(pod_uid, None)
+
+    def allocations(self, pod_uid: str) -> Dict[str, List[str]]:
+        """The devices a pod holds (the PodResources API surface)."""
+        with self._mu:
+            return {r: list(ds) for r, ds in
+                    self._allocations.get(pod_uid, {}).items()}
+
+
 class ImageGCManager:
     """High/low watermark GC over the runtime's image store
     (image_gc_manager.go:83 ImageGCPolicy + realImageGCManager

@@ -49,7 +49,9 @@ class Kubelet:
                  image_gc_low_percent: int = 80,
                  image_gc_period: float = 10.0,
                  clock=time.time):
-        from kubernetes_tpu.kubelet.cm import ContainerManager, ImageGCManager
+        from kubernetes_tpu.kubelet.cm import (
+            ContainerManager, DevicePluginManager, ImageGCManager)
+        from kubernetes_tpu.kubelet.volumemanager import VolumeManager
 
         self.client = client
         self.node_name = node_name
@@ -113,6 +115,13 @@ class Kubelet:
                                        image_gc_low_percent)
         self._image_gc_period = image_gc_period
         self._last_image_gc = 0.0
+        # device plugins (cm/devicemanager) + volume manager
+        # (kubelet/volumemanager): device capacity rides the heartbeat,
+        # admission allocates concrete device ids, the attach gate holds
+        # containers until the controller attaches, volumesInUse is OUR
+        # report
+        self.device_manager = DevicePluginManager()
+        self.volume_manager = VolumeManager()
 
     # ------------------------------------------------------------------ #
     # node registration + heartbeat (kubelet_node_status.go)
@@ -124,8 +133,9 @@ class Kubelet:
             "metadata": {"name": self.node_name, "labels": dict(self.labels)},
             "spec": {},
             "status": {
-                "capacity": dict(self.capacity),
-                "allocatable": self.container_manager.allocatable(),
+                "capacity": self._capacity_with_devices(),
+                "allocatable": {**self.container_manager.allocatable(),
+                                **self._device_capacity()},
                 "conditions": [self._ready_condition()],
                 "nodeInfo": {"kubeletVersion": "v1.17.0-tpu.1"},
                 "addresses": [{"type": "Hostname",
@@ -139,6 +149,13 @@ class Kubelet:
                 raise
             # re-registration keeps the existing object, refreshes status
             self._heartbeat()
+
+    def _device_capacity(self) -> Dict[str, str]:
+        return {res: str(n)
+                for res, n in self.device_manager.capacity().items()}
+
+    def _capacity_with_devices(self) -> Dict[str, str]:
+        return {**self.capacity, **self._device_capacity()}
 
     def _ready_condition(self) -> Obj:
         return {"type": "Ready", "status": "True", "reason": "KubeletReady",
@@ -172,9 +189,14 @@ class Kubelet:
                     if self.under_disk_pressure
                     else "KubeletHasNoDiskPressure"})
             node.setdefault("status", {})["conditions"] = conds
-            node["status"]["capacity"] = dict(self.capacity)
-            node["status"]["allocatable"] = \
-                self.container_manager.allocatable()
+            node["status"]["capacity"] = self._capacity_with_devices()
+            node["status"]["allocatable"] = {
+                **self.container_manager.allocatable(),
+                **self._device_capacity()}
+            # volume manager halves of the attach/detach protocol: learn
+            # what the controller attached, report what we hold mounted
+            self.volume_manager.note_attached(node.get("status", {}))
+            node["status"]["volumesInUse"] = self.volume_manager.in_use()
             self.client.nodes.update_status(node, "")
         except errors.StatusError:
             pass
@@ -311,6 +333,24 @@ class Kubelet:
                     if self._informer else []
                 ok, reason, message = self.container_manager.admit(
                     pod, active)
+                if ok:
+                    # device-plugin resources allocate CONCRETE device ids
+                    # at admission (devicemanager Allocate) — exhaustion
+                    # rejects like any other resource
+                    from kubernetes_tpu.kubelet.cm import (
+                        pod_extended_requests)
+
+                    plugin_caps = self.device_manager.capacity()
+                    dev_req = {r: n for r, n in
+                               pod_extended_requests(pod).items()
+                               if r in plugin_caps}
+                    if dev_req and not self.device_manager.allocate(
+                            uid, dev_req):
+                        ok = False
+                        worst = sorted(dev_req)[0]
+                        reason = f"OutOf{worst}"
+                        message = (f"Node didn't have enough resource: "
+                                   f"{worst} (device plugin)")
                 if not ok:
                     # rejectPod: no sandbox is ever created; the Failed
                     # status (reason OutOfcpu/OutOfmemory/OutOfpods)
@@ -337,6 +377,21 @@ class Kubelet:
             return
         with self._pod_mu:
             if uid in self._evicted or self._sandbox_by_uid.get(uid) is None:
+                return
+            # volumesInUse marks BEFORE the attach gate and UNDER the pod
+            # lock (reference order: markVolumesInUse precedes mounting):
+            # the in-use report must cover a pod still WAITING for its
+            # attach, or a delete between heartbeats detaches under an
+            # active mount; and marking after the evicted/sandbox check
+            # means a concurrent teardown's unmount can't be overwritten
+            # by a stale sync (permanent attach leak otherwise)
+            self.volume_manager.mark_mounted(uid, pod)
+            # WaitForAttachAndMount: containers hold until the attach/
+            # detach controller attached every attach-requiring volume;
+            # housekeeping retries the sync
+            ok_vols, _missing = \
+                self.volume_manager.wait_for_attach_and_mount(pod)
+            if not ok_vols:
                 return
             sid = self._sandbox_by_uid[uid]
             cids = self._containers_by_uid.setdefault(uid, [])
@@ -511,6 +566,8 @@ class Kubelet:
             for d in (self._probe_state, self._restart_counts):
                 for k in [k for k in d if k[0] == uid]:
                     del d[k]
+        self.device_manager.deallocate(uid)
+        self.volume_manager.unmount(uid)
         if sid is not None:
             try:
                 self.cri.stop_pod_sandbox(sid)
@@ -679,6 +736,8 @@ class Kubelet:
             for d in (self._probe_state, self._restart_counts):
                 for k in [k for k in d if k[0] == uid]:
                     del d[k]
+        self.device_manager.deallocate(uid)
+        self.volume_manager.unmount(uid)
         with self._status_mu:
             self._last_status.pop(meta.namespaced_key(pod), None)
         if self.checkpoints:

@@ -724,7 +724,7 @@ class TestHorizontalPodAutoscaler:
         # generous: under full-suite load the controller's resync tick can
         # lag well past the 10s default
         assert wait_for(lambda: client.deployments.get("web")
-                        ["spec"]["replicas"] == 6, timeout=30)
+                        ["spec"]["replicas"] == 6, timeout=60)
         st = client.horizontalpodautoscalers.get("web").get("status", {})
         assert st.get("desiredReplicas") == 6
 

@@ -151,7 +151,7 @@ class TestEndToEnd:
                                 "key": "disk", "operator": "In",
                                 "values": ["ssd"]}]}]}}}}})
         assert wait_for(lambda: client.pods.get("pinned")["spec"]
-                        .get("nodeName") == "hollow-node-1", timeout=30)
+                        .get("nodeName") == "hollow-node-1", timeout=60)
 
     def test_scheduler_records_failed_scheduling_event(self, cluster):
         client, hollow, sched, cm = cluster

@@ -344,3 +344,106 @@ class TestSoftEvictionAndNodefs:
             cm.stop()
             k.stop()
             api.close()
+
+
+class TestDevicePluginManager:
+    def test_register_allocate_exhaust(self):
+        from kubernetes_tpu.kubelet.cm import DevicePluginManager
+
+        dm = DevicePluginManager()
+        dm.register("example.com/tpu", ["tpu-0", "tpu-1", "tpu-2",
+                                        "tpu-3"])
+        assert dm.capacity() == {"example.com/tpu": 4}
+        assert dm.allocate("pod-a", {"example.com/tpu": 3})
+        assert len(dm.allocations("pod-a")["example.com/tpu"]) == 3
+        # all-or-nothing: 2 wanted, 1 free → nothing allocated
+        assert not dm.allocate("pod-b", {"example.com/tpu": 2})
+        assert dm.allocations("pod-b") == {}
+        assert dm.allocate("pod-b", {"example.com/tpu": 1})
+        dm.deallocate("pod-a")
+        assert dm.available()["example.com/tpu"] == 3
+        # unhealthy devices leave capacity and allocation
+        dm.set_health("example.com/tpu", "tpu-0", False)
+        assert dm.capacity()["example.com/tpu"] == 3
+
+    def test_kubelet_advertises_and_enforces_devices(self):
+        api = APIServer()
+        client = Client.local(api)
+        k = Kubelet(client, "n1", housekeeping_interval=0.2)
+        k.device_manager.register("example.com/tpu", ["t0", "t1"])
+        try:
+            k.start()
+            node = client.nodes.get("n1", "")
+            assert node["status"]["capacity"]["example.com/tpu"] == "2"
+            assert node["status"]["allocatable"]["example.com/tpu"] == "2"
+
+            def dev_pod(name, n):
+                return {"apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": name, "namespace": "default"},
+                        "spec": {"nodeName": "n1", "containers": [{
+                            "name": "c", "image": "i",
+                            "resources": {"requests": {
+                                "example.com/tpu": str(n)}}}]}}
+
+            client.pods.create(dev_pod("holder", 2))
+            assert wait_for(lambda: client.pods.get("holder")
+                            .get("status", {}).get("phase") == "Running")
+            assert len(k.device_manager.allocations(
+                meta.uid(client.pods.get("holder")))["example.com/tpu"]) \
+                == 2
+            # exhausted: next device pod is REJECTED by the kubelet
+            client.pods.create(dev_pod("greedy", 1))
+            assert wait_for(lambda: client.pods.get("greedy")
+                            .get("status", {}).get("phase") == "Failed")
+            assert client.pods.get("greedy")["status"]["reason"] == \
+                "OutOfexample.com/tpu"
+            # deleting the holder frees the devices
+            client.pods.delete("holder", "default")
+            assert wait_for(lambda: k.device_manager.available()
+                            .get("example.com/tpu") == 2)
+        finally:
+            k.stop()
+            api.close()
+
+
+class TestVolumeManagerKubelet:
+    def test_attach_gate_and_volumes_in_use(self):
+        """The kubelet half of the attach/detach protocol: containers hold
+        until the controller attaches; volumesInUse is the kubelet's
+        report; teardown clears it so the deferred detach proceeds."""
+        from kubernetes_tpu.controllers import ControllerManager
+
+        api = APIServer()
+        client = Client.local(api)
+        k = Kubelet(client, "n1", heartbeat_interval=0.2,
+                    housekeeping_interval=0.2)
+        cm = ControllerManager(client, controllers=["attachdetach"],
+                               poll_interval=0.2).start()
+        try:
+            k.start()
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "data-pod", "namespace": "default"},
+                "spec": {"nodeName": "n1",
+                         "containers": [{"name": "c", "image": "i"}],
+                         "volumes": [{"name": "d", "gcePersistentDisk":
+                                      {"pdName": "disk-9"}}]}})
+            vol = "kubernetes.io/gcePersistentDisk/disk-9"
+            # controller attaches → kubelet learns on heartbeat → starts
+            assert wait_for(lambda: client.pods.get("data-pod")
+                            .get("status", {}).get("phase") == "Running",
+                            timeout=30)
+            assert wait_for(lambda: vol in (client.nodes.get("n1", "")
+                            .get("status", {}).get("volumesInUse") or []),
+                            timeout=10)
+            # pod leaves → kubelet clears in-use → controller detaches
+            client.pods.delete("data-pod", "default")
+            assert wait_for(lambda: client.nodes.get("n1", "")
+                            .get("status", {}).get("volumesAttached") == [],
+                            timeout=20)
+            assert vol not in (client.nodes.get("n1", "")
+                               .get("status", {}).get("volumesInUse") or [])
+        finally:
+            cm.stop()
+            k.stop()
+            api.close()
