@@ -353,9 +353,7 @@ class BootstrapTokenAuthenticator:
             return None
         if secret.get("type") != BOOTSTRAP_SECRET_TYPE:
             return None
-        data = {**(secret.get("stringData") or {}),
-                **{k: base64.b64decode(v).decode()
-                   for k, v in (secret.get("data") or {}).items()}}
+        data = _bootstrap_secret_data(secret)
         if data.get("token-secret") != tsecret:
             return None
         if data.get("usage-bootstrap-authentication") != "true":
@@ -375,6 +373,161 @@ class BootstrapTokenAuthenticator:
                        data.get("auth-extra-groups", "").split(",") if g)
         return UserInfo(f"system:bootstrap:{tid}",
                         ("system:authenticated",) + groups)
+
+
+def _bootstrap_secret_data(secret: Obj) -> Dict[str, str]:
+    """Decode a bootstrap Secret's data tolerantly: a key with invalid
+    base64 / non-UTF-8 bytes is skipped, never allowed to abort the
+    caller's whole pass."""
+    out: Dict[str, str] = dict(secret.get("stringData") or {})
+    for k, v in (secret.get("data") or {}).items():
+        try:
+            out[k] = base64.b64decode(v).decode()
+        except Exception:  # noqa: BLE001 — malformed entry: skip the key
+            continue
+    return out
+
+
+class TokenCleanerController(Controller):
+    """`pkg/controller/bootstrap/tokencleaner.go`: kube-system
+    bootstrap-token Secrets past their expiration are deleted — an
+    expired token must stop authenticating AND disappear. Scoped to
+    kube-system, as the reference: user Secrets of the same type in
+    other namespaces are never touched."""
+
+    name = "tokencleaner"
+
+    def __init__(self, client, factory, clock=time.time):
+        super().__init__(client, factory)
+        self.clock = clock
+        self.secret_informer = self.watch_resource("secrets")
+
+    def poll_once(self, now=None) -> None:
+        # expiry is time-driven, not event-driven: re-scan on the manager's
+        # poll tick so a token expires without needing a Secret event
+        for s in self.secret_informer.lister.list():
+            if s.get("type") == BOOTSTRAP_SECRET_TYPE and \
+                    meta.namespace(s) == "kube-system":
+                self.enqueue(s)
+
+    def sync(self, key: str) -> None:
+        ns, _, name = key.partition("/")
+        if ns != "kube-system":
+            return
+        try:
+            secret = self.client.secrets.get(name, ns)
+        except errors.StatusError:
+            return
+        if secret.get("type") != BOOTSTRAP_SECRET_TYPE:
+            return
+        exp = _bootstrap_secret_data(secret).get("expiration", "")
+        if not exp:
+            return
+        try:
+            when = datetime.datetime.fromisoformat(
+                exp.replace("Z", "+00:00"))
+            if when.tzinfo is None:
+                when = when.replace(tzinfo=datetime.timezone.utc)
+        except (ValueError, TypeError):
+            # unparseable expirations are treated as expired (the
+            # reference logs and deletes — a token that cannot prove
+            # validity must not live forever)
+            when = datetime.datetime.fromtimestamp(
+                0, datetime.timezone.utc)
+        now = datetime.datetime.fromtimestamp(self.clock(),
+                                              datetime.timezone.utc)
+        if when <= now:
+            try:
+                self.client.secrets.delete(name, ns)
+            except errors.StatusError:
+                pass
+
+
+def jws_sign_claim(content: str, token_id: str, token_secret: str) -> str:
+    """Compact JWS (HS256) over the cluster-info payload, keyed by the
+    bootstrap token — `pkg/controller/bootstrap/jws.go computeDetachedSig`
+    (the kid claim carries the token id so joiners can pick their sig)."""
+    import hashlib
+    import hmac
+    import json as _json
+
+    def b64url(b: bytes) -> str:
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    header = b64url(_json.dumps(
+        {"alg": "HS256", "kid": token_id},
+        separators=(",", ":"), sort_keys=True).encode())
+    payload = b64url(content.encode())
+    mac = hmac.new(token_secret.encode(),
+                   f"{header}.{payload}".encode(), hashlib.sha256).digest()
+    # detached signature: the payload travels in the ConfigMap itself
+    return f"{header}..{b64url(mac)}"
+
+
+class BootstrapSignerController(Controller):
+    """`pkg/controller/bootstrap/bootstrapsigner.go`: keep the kube-public
+    cluster-info ConfigMap signed with a JWS per usable bootstrap token
+    (`jws-kubeadm-<tokenid>` keys), so joiners can verify the cluster CA
+    they are told about USING ONLY their token."""
+
+    name = "bootstrapsigner"
+
+    def __init__(self, client, factory):
+        super().__init__(client, factory)
+        # only bootstrap-token churn (kube-system) re-signs: unrelated
+        # secret events must not each trigger a GET + full HMAC pass
+        self.secret_informer = self.watch_resource(
+            "secrets", enqueue_fn=lambda o: (
+                self.enqueue_key("cluster-info")
+                if o.get("type") == BOOTSTRAP_SECRET_TYPE
+                and meta.namespace(o) == "kube-system" else None))
+        self.cm_informer = self.watch_resource(
+            "configmaps", enqueue_fn=lambda o: (
+                self.enqueue_key("cluster-info")
+                if meta.name(o) == "cluster-info" else None))
+
+    def sync(self, key: str) -> None:
+        # the manager's resync enqueues raw object keys; anything other
+        # than cluster-info or a kube-system bootstrap token is noise
+        # (the pass itself is keyed on nothing — dedup to one real run)
+        ns, _, name = key.rpartition("/")
+        if ns not in ("", "kube-system", "kube-public"):
+            return
+        if ns == "kube-system" and not name.startswith("bootstrap-token-"):
+            return
+        if ns == "kube-public" and name != "cluster-info":
+            return
+        try:
+            cm = self.client.configmaps.get("cluster-info", "kube-public")
+        except errors.StatusError:
+            return  # nothing to sign until kubeadm publishes it
+        content = (cm.get("data") or {}).get("kubeconfig", "")
+        if not content:
+            return
+        want = {}
+        for s in self.secret_informer.lister.list():
+            if s.get("type") != BOOTSTRAP_SECRET_TYPE or \
+                    meta.namespace(s) != "kube-system":
+                continue
+            data = _bootstrap_secret_data(s)
+            if data.get("usage-bootstrap-signing") != "true":
+                continue
+            tid, tsecret = data.get("token-id"), data.get("token-secret")
+            if tid and tsecret:
+                want[f"jws-kubeadm-{tid}"] = jws_sign_claim(
+                    content, tid, tsecret)
+        have = {k: v for k, v in (cm.get("data") or {}).items()
+                if k.startswith("jws-kubeadm-")}
+        if have == want:
+            return
+        new_data = {k: v for k, v in (cm.get("data") or {}).items()
+                    if not k.startswith("jws-kubeadm-")}
+        new_data.update(want)
+        cm["data"] = new_data
+        try:
+            self.client.configmaps.update(cm, "kube-public")
+        except errors.StatusError:
+            pass  # conflict: informer re-enqueues with the fresh copy
 
 
 # --------------------------------------------------------------------- #

@@ -35,9 +35,11 @@ from kubernetes_tpu.controllers.autoscale import (
     VolumeExpansionController,
 )
 from kubernetes_tpu.controllers.certificates import (
+    BootstrapSignerController,
     ClusterRoleAggregationController,
     CSRApprovingController,
     CSRSigningController,
+    TokenCleanerController,
 )
 from kubernetes_tpu.controllers.workloads import (
     CronJobController,
@@ -74,6 +76,8 @@ DEFAULT_CONTROLLERS: Dict[str, Callable] = {
     "csrsigning": CSRSigningController,
     "csrapproving": CSRApprovingController,
     "clusterroleaggregation": ClusterRoleAggregationController,
+    "tokencleaner": TokenCleanerController,
+    "bootstrapsigner": BootstrapSignerController,
 }
 
 
@@ -136,7 +140,7 @@ class ControllerManager:
                 except Exception:  # noqa: BLE001
                     pass
             for name in ("nodelifecycle", "cronjob", "podgc", "job",
-                         "ttlafterfinished", "daemonset"):
+                         "ttlafterfinished", "daemonset", "tokencleaner"):
                 c = self.controllers.get(name)
                 if c is not None and hasattr(c, "poll_once"):
                     try:

@@ -361,3 +361,66 @@ class TestIdentityStamping:
                         "certificatesigningrequests/settled/approval",
                         {}, stale)
         assert ei.value.code == 422
+
+
+class TestBootstrapControllers:
+    def test_token_cleaner_deletes_expired(self, client):
+        from kubernetes_tpu.controllers import ControllerManager
+
+        cm = ControllerManager(client, controllers=["tokencleaner"],
+                               poll_interval=0.2).start()
+        try:
+            live, live_secret = make_bootstrap_token()
+            client.secrets.create(live_secret, "kube-system")
+            dead, dead_secret = make_bootstrap_token()
+            dead_secret["stringData"]["expiration"] = \
+                "2000-01-01T00:00:00Z"
+            client.secrets.create(dead_secret, "kube-system")
+            dead_name = dead_secret["metadata"]["name"]
+            live_name = live_secret["metadata"]["name"]
+            assert wait_for(lambda: not _secret_exists(
+                client, dead_name), timeout=15)
+            assert _secret_exists(client, live_name)
+        finally:
+            cm.stop()
+
+    def test_bootstrap_signer_signs_cluster_info(self, client):
+        from kubernetes_tpu.controllers import ControllerManager
+        from kubernetes_tpu.controllers.certificates import jws_sign_claim
+
+        cm = ControllerManager(client, controllers=["bootstrapsigner"],
+                               poll_interval=0.2).start()
+        try:
+            client.configmaps.create({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "cluster-info",
+                             "namespace": "kube-public"},
+                "data": {"kubeconfig": "clusters: [the-ca-payload]"}},
+                "kube-public")
+            token, secret = make_bootstrap_token()
+            client.secrets.create(secret, "kube-system")
+            tid, _, tsecret = token.partition(".")
+
+            def signed():
+                cmap = client.configmaps.get("cluster-info", "kube-public")
+                return f"jws-kubeadm-{tid}" in (cmap.get("data") or {})
+
+            assert wait_for(signed, timeout=15)
+            cmap = client.configmaps.get("cluster-info", "kube-public")
+            # the signature verifies with ONLY the token
+            assert cmap["data"][f"jws-kubeadm-{tid}"] == jws_sign_claim(
+                "clusters: [the-ca-payload]", tid, tsecret)
+            # deleting the token removes its signature
+            client.secrets.delete(secret["metadata"]["name"],
+                                  "kube-system")
+            assert wait_for(lambda: not signed(), timeout=15)
+        finally:
+            cm.stop()
+
+
+def _secret_exists(client, name):
+    try:
+        client.secrets.get(name, "kube-system")
+        return True
+    except errors.StatusError:
+        return False
