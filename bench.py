@@ -347,8 +347,11 @@ def _control_stage(n_nodes, n_pods):
     # capacity provisioning: size the shape buckets for the EXPECTED
     # cluster so steady-state throughput is measured without mid-run
     # growth recompiles (those are the growth stage's subject)
+    # batch_window 0.15 s: an ingest STORM coalesces into few large waves
+    # (each wave pays a snapshot patch + dispatch; per-pod latency floor
+    # rises by the window, the throughput/latency knob a storm favors)
     server = SchedulerServer(
-        client, cycle_interval=0.02, batch_window=0.05,
+        client, cycle_interval=0.02, batch_window=0.15,
         base_dims=Dims(N=bucket(n_nodes), P=bucket(min(n_pods, 8192)),
                        E=bucket(n_pods + 256))).start()
 
