@@ -196,6 +196,11 @@ def _probe_backend(timeout):
         if r.get("ok"):
             return dict(os.environ), r.get("backend", "tpu"), diags
         diags.append({"probe_attempt": attempt, **r})
+        if "timeout" in str(r.get("error", "")):
+            # the probe HUNG (dead tunnel — jax.devices() blocks, it does
+            # not fail): a retry would hang identically and burn another
+            # probe_timeout out of the global budget. Fail-fast to CPU.
+            break
         time.sleep(5 * attempt)
     return _cpu_env(os.environ), "cpu (tpu init failed)", diags
 
