@@ -161,6 +161,21 @@ class Store:
         items, rv = self.storage.list(self.prefix_for(namespace), pred)
         return self.scheme.new_list(self.info, items, rv)
 
+    # resources whose spec is immutable after create: the reference's
+    # strategy PrepareForUpdate copies the old spec over the incoming one
+    # (csrStrategy pins newCSR.Spec = oldCSR.Spec — a mutable CSR spec
+    # would let a requester swap in a forged username/groups AFTER the
+    # server stamped the authenticated identity at create time)
+    _IMMUTABLE_SPEC_RESOURCES = frozenset({"certificatesigningrequests"})
+
+    def _pin_immutable_spec(self, cur: Obj, new: Obj) -> None:
+        """PrepareForUpdate spec pinning for _IMMUTABLE_SPEC_RESOURCES: the
+        stored spec silently wins on plain update/patch, exactly like the
+        reference strategy (not a 400 — kubectl apply round-trips specs)."""
+        if self.info.resource in self._IMMUTABLE_SPEC_RESOURCES \
+                and "spec" in cur:
+            new["spec"] = meta.deep_copy(cur["spec"])
+
     def update(self, namespace: str, name: str, obj: Obj,
                subresource: str = "") -> Obj:
         """Full-object PUT. resourceVersion in the body, if set, is the
@@ -226,6 +241,7 @@ class Store:
                 # spec updates keep status (registry strategy PrepareForUpdate)
                 if "status" in cur and "status" not in new:
                     new["status"] = cur["status"]
+                self._pin_immutable_spec(cur, new)
                 if _spec_changed(cur, new):
                     nm["generation"] = int(cm.get("generation", 1)) + 1
             self.scheme.default(new)
@@ -281,6 +297,8 @@ class Store:
                       "resourceVersion", "deletionTimestamp"):
                 if f in cm:
                     nm[f] = cm[f]
+            if subresource == "":
+                self._pin_immutable_spec(cur, new)
             if subresource == "" and _spec_changed(cur, new):
                 nm["generation"] = int(cm.get("generation", 1)) + 1
             self.scheme.default(new)

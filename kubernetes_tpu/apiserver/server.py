@@ -330,6 +330,15 @@ _AUDIT_VERBS = {"POST": "create", "PUT": "update", "PATCH": "patch",
                 "DELETE": "delete"}
 
 
+def _is_csr_create_path(path: str) -> bool:
+    """True when a POST path resolves to the certificatesigningrequests
+    COLLECTION — the requester-identity stamp must key on what the server
+    will actually create (the resolved resource), not on body `kind`, which
+    the registry merely defaults."""
+    parts = [p for p in path.split("/") if p]
+    return bool(parts) and parts[-1] == "certificatesigningrequests"
+
+
 class _ConvertingWatch:
     """Wraps a Watch, converting every event's object to the requested CRD
     version on delivery — what makes `watch sees converted objects` true for
@@ -709,14 +718,18 @@ class _Handler(BaseHTTPRequestHandler):
                     user = uinfo.name if uinfo is not None else ""
                     if (uinfo is not None and method == "POST"
                             and isinstance(body, dict)
-                            and body.get("kind") ==
-                            "CertificateSigningRequest"):
+                            and _is_csr_create_path(parsed.path)):
                         # the SERVER stamps the requester identity
                         # (registry/certificates strategy
                         # PrepareForCreate): client-claimed username/
                         # groups are overwritten, or bootstrap-group
                         # membership would be forgeable and the
-                        # auto-approver's trust in spec.groups unfounded
+                        # auto-approver's trust in spec.groups unfounded.
+                        # Keyed on the RESOLVED RESOURCE PATH, never the
+                        # body's kind: Store.create defaults an omitted
+                        # kind AFTER this check, so a kind-less POST to
+                        # the CSR collection used to slip through with
+                        # forged spec.username/groups intact
                         body.setdefault("spec", {})["username"] = uinfo.name
                         body["spec"]["groups"] = list(uinfo.groups)
             except errors.StatusError as e:

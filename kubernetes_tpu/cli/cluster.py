@@ -18,6 +18,7 @@ into the scheduler exactly as `--config` does for the reference binary.
 
 from __future__ import annotations
 
+import base64
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -108,7 +109,7 @@ class Cluster:
             import secrets as pysecrets
 
             from kubernetes_tpu.apiserver.auth import (
-                AuthGate, TokenAuthenticator)
+                AuthGate, RBACAuthorizer, TokenAuthenticator)
             from kubernetes_tpu.controllers.certificates import (
                 BootstrapTokenAuthenticator)
 
@@ -117,7 +118,16 @@ class Cluster:
             ta.add(self.admin_token, "kubernetes-admin",
                    ("system:masters",))
             ta.chain.append(BootstrapTokenAuthenticator(self.api))
-            auth_gate = AuthGate(authenticator=ta, allow_anonymous=False)
+            # RBAC at the gateway: without an authorizer every
+            # authenticated identity — including a joiner's bootstrap
+            # token — had unrestricted access (e.g. GET of the kube-system
+            # cluster-ca Secret holding the CA private key). The reference
+            # confines system:bootstrappers to posting/collecting CSRs;
+            # _seed_rbac_policy writes the same confinement
+            self._seed_rbac_policy()
+            auth_gate = AuthGate(authenticator=ta,
+                                 authorizer=RBACAuthorizer(self.api),
+                                 allow_anonymous=False)
         self.gateway = HTTPGateway(self.api, host=cfg.host, port=cfg.port,
                                    auth_gate=auth_gate).start()
         self.client = Client.http(self.gateway.url,
@@ -143,11 +153,113 @@ class Cluster:
         except merrors.StatusError as e:
             if not merrors.is_already_exists(e):
                 raise
+        if cfg.authenticated:
+            # kube-public/cluster-info (kubeadm init phase bootstrap-token):
+            # the CA CERTIFICATE published where bootstrappers may read it —
+            # under RBAC they can no longer GET the kube-system cluster-ca
+            # Secret (which also holds the CA private key)
+            self._publish_cluster_info()
         if cfg.hollow_nodes:
             self.hollow = HollowCluster(
                 self.client, cfg.hollow_nodes,
                 capacity=cfg.hollow_capacity).start()
         return self
+
+    def _seed_rbac_policy(self) -> None:
+        """Write the authenticated topology's RBAC policy straight into
+        storage (before the gateway opens): system:masters is cluster-admin,
+        and system:bootstrappers gets EXACTLY the reference's
+        system:node-bootstrapper surface — CSR create/get/list/watch plus a
+        read of kube-public/cluster-info — so a leaked bootstrap token can
+        request a node certificate but cannot read the CA private key, list
+        Secrets, or touch workloads."""
+        from kubernetes_tpu.controllers.certificates import BOOTSTRAP_GROUP
+
+        g = "rbac.authorization.k8s.io"
+        gv = f"{g}/v1"
+        objs = [
+            ("clusterroles", "", {
+                "apiVersion": gv, "kind": "ClusterRole",
+                "metadata": {"name": "cluster-admin"},
+                "rules": [
+                    {"verbs": ["*"], "apiGroups": ["*"],
+                     "resources": ["*"]},
+                    {"verbs": ["*"], "nonResourceURLs": ["*"]},
+                ]}),
+            ("clusterrolebindings", "", {
+                "apiVersion": gv, "kind": "ClusterRoleBinding",
+                "metadata": {"name": "cluster-admin"},
+                "subjects": [{"kind": "Group", "name": "system:masters"}],
+                "roleRef": {"kind": "ClusterRole", "name": "cluster-admin"}}),
+            ("clusterroles", "", {
+                "apiVersion": gv, "kind": "ClusterRole",
+                "metadata": {"name": "system:node-bootstrapper"},
+                "rules": [
+                    {"verbs": ["create", "get", "list", "watch"],
+                     "apiGroups": ["certificates.k8s.io"],
+                     "resources": ["certificatesigningrequests"]},
+                ]}),
+            ("clusterrolebindings", "", {
+                "apiVersion": gv, "kind": "ClusterRoleBinding",
+                "metadata": {"name": "kubeadm:node-bootstrappers"},
+                "subjects": [{"kind": "Group", "name": BOOTSTRAP_GROUP}],
+                "roleRef": {"kind": "ClusterRole",
+                            "name": "system:node-bootstrapper"}}),
+            ("roles", "kube-public", {
+                "apiVersion": gv, "kind": "Role",
+                "metadata": {"name": "kubeadm:bootstrap-signer-clusterinfo",
+                             "namespace": "kube-public"},
+                "rules": [{"verbs": ["get"], "apiGroups": [""],
+                           "resources": ["configmaps"],
+                           "resourceNames": ["cluster-info"]}]}),
+            ("rolebindings", "kube-public", {
+                "apiVersion": gv, "kind": "RoleBinding",
+                "metadata": {"name": "kubeadm:bootstrap-signer-clusterinfo",
+                             "namespace": "kube-public"},
+                "subjects": [{"kind": "Group", "name": BOOTSTRAP_GROUP}],
+                "roleRef": {"kind": "Role",
+                            "name": "kubeadm:bootstrap-signer-clusterinfo"}}),
+        ]
+        from kubernetes_tpu.machinery import errors as merrors
+
+        for resource, ns, obj in objs:
+            try:
+                self.api.store(g, resource).create(ns, obj)
+            except merrors.StatusError as e:
+                if not merrors.is_already_exists(e):
+                    raise
+
+    def _publish_cluster_info(self) -> None:
+        """kube-public/cluster-info: the CA certificate + a minimal
+        kubeconfig, readable by bootstrappers (and signed per usable token
+        by the BootstrapSignerController when it runs)."""
+        import json as _json
+
+        from kubernetes_tpu.controllers.certificates import _shared_ca
+        from kubernetes_tpu.machinery import errors as merrors
+
+        try:
+            ca_pem = _shared_ca(self.client).ca_pem().decode()
+        except ImportError:
+            # no `cryptography` in this environment: there is no CA to
+            # publish (CSR signing is equally unavailable) — skip the
+            # ConfigMap rather than fail the whole control-plane bringup
+            return
+        kubeconfig = _json.dumps({
+            "apiVersion": "v1", "kind": "Config",
+            "clusters": [{"name": "", "cluster": {
+                "server": self.gateway.url if self.gateway else "",
+                "certificate-authority-data": base64.b64encode(
+                    ca_pem.encode()).decode()}}]})
+        cm = {"apiVersion": "v1", "kind": "ConfigMap",
+              "metadata": {"name": "cluster-info",
+                           "namespace": "kube-public"},
+              "data": {"ca.crt": ca_pem, "kubeconfig": kubeconfig}}
+        try:
+            self.client.configmaps.create(cm, "kube-public")
+        except merrors.StatusError as e:
+            if not merrors.is_already_exists(e):
+                raise
 
     def join(self, n_nodes: int = 1, name_prefix: Optional[str] = None,
              capacity: Optional[Dict[str, str]] = None) -> "HollowCluster":

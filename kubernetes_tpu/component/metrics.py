@@ -126,12 +126,16 @@ class Histogram(_Metric):
                    f"# TYPE {self.name} {self.TYPE}"]
             for k in sorted(self._totals):
                 for i, b in enumerate(self.buckets):
+                    # no backslashes inside f-string expressions: that is a
+                    # Python ≥3.12 feature and this tree must import on 3.10
+                    le = 'le="%s"' % b
                     out.append(
                         f"{self.name}_bucket"
-                        f"{self._fmt_labels(self.label_names, k, f'le=\"{b}\"')}"
+                        f"{self._fmt_labels(self.label_names, k, le)}"
                         f" {self._counts[k][i]}")
+                le_inf = 'le="+Inf"'
                 out.append(f"{self.name}_bucket"
-                           f"{self._fmt_labels(self.label_names, k, 'le=\"+Inf\"')}"
+                           f"{self._fmt_labels(self.label_names, k, le_inf)}"
                            f" {self._totals[k]}")
                 out.append(f"{self.name}_sum"
                            f"{self._fmt_labels(self.label_names, k)}"

@@ -621,9 +621,20 @@ def collect_node_identity(client, node_name: str, key_pem: bytes,
         time.sleep(0.1)
     if not cert_b64:
         raise TimeoutError(f"CSR {name} was not issued within {timeout}s")
-    ca_secret = client.secrets.get("cluster-ca", "kube-system")
-    ca_pem = base64.b64decode((ca_secret.get("data") or {})
-                              .get("tls.crt", ""))
+    # CA certificate: kube-public/cluster-info first — the only CA source a
+    # bootstrap-token identity may read under RBAC (the kube-system
+    # cluster-ca Secret also holds the CA PRIVATE KEY and is admin-only);
+    # fall back to the Secret for admin callers / unauthenticated clusters
+    ca_pem = b""
+    try:
+        cm = client.configmaps.get("cluster-info", "kube-public")
+        ca_pem = ((cm.get("data") or {}).get("ca.crt") or "").encode()
+    except errors.StatusError:
+        pass
+    if not ca_pem:
+        ca_secret = client.secrets.get("cluster-ca", "kube-system")
+        ca_pem = base64.b64decode((ca_secret.get("data") or {})
+                                  .get("tls.crt", ""))
     return {"key": key_pem, "cert": base64.b64decode(cert_b64),
             "ca": ca_pem}
 

@@ -347,7 +347,14 @@ def json_patch(doc: Obj, ops: List[Obj]) -> Obj:
         kind = op.get("op")
         parts = _ptr_parts(op.get("path", ""))
         if kind in ("add", "replace", "test"):
-            value = copy.deepcopy(op.get("value"))
+            # RFC 6902 §4: add/replace/test REQUIRE the "value" member —
+            # defaulting an absent value to null would silently null out
+            # the target (evanphx/json-patch, the reference's library,
+            # rejects it too)
+            if "value" not in op:
+                raise errors.new_bad_request(
+                    f'JSON patch {kind}: missing "value" member')
+            value = copy.deepcopy(op["value"])
         if kind == "move" or kind == "copy":
             f_parts = _ptr_parts(op.get("from", ""))
             parent, tok = _ptr_walk(out, f_parts)

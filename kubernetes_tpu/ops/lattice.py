@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..state.arrays import Array, ClusterTables, PodArrays
 from .interpod import class_term_membership, per_node_counts, term_class_matrix
@@ -69,17 +70,48 @@ class EngineConfig(NamedTuple):
     w_window: Array = 100.0
 
 
+def _strong_f32(x):
+    # python scalars become NUMPY f32 scalars: concrete (safe to build and
+    # cache even while a jit trace is active — jnp.asarray there would
+    # stage a traced constant and leak the tracer via the cache) and
+    # strong-typed for jit. Already-normalized np.float32 leaves pass
+    # through untouched so re-normalizing a config on the per-dispatch hot
+    # path is free; other array leaves go through jnp.asarray.
+    if isinstance(x, np.float32):
+        return x
+    if isinstance(x, (bool, int, float)):
+        return np.float32(x)
+    return jnp.asarray(x, jnp.float32)
+
+
+def strong_engine_config(cfg: "EngineConfig") -> "EngineConfig":
+    """Normalize an EngineConfig's leaves to STRONG-typed f32 scalars.
+    Python floats trace as weak-typed f32, which keys a different jit cache
+    entry (and a different persistent-cache HLO hash) than the prewarmer's
+    strongly-typed abstract scalars — the prewarmed executable would never
+    be reused. Every dispatch boundary routes its config through this."""
+    return EngineConfig(*(_strong_f32(x) for x in cfg))
+
+
+_DEFAULT_ECFG: "EngineConfig | None" = None
+
+
 def default_engine_config() -> EngineConfig:
     """The default provider's composition: every filter on, the default score
-    set at weight 1, MostAllocated off (algorithmprovider/defaults)."""
-    one, zero = 1.0, 0.0
-    return EngineConfig(
-        f_unsched=one, f_name=one, f_ports=one, f_node_affinity=one,
-        f_fit=one, f_taints=one, f_interpod=one, f_spread=one,
-        f_volrestrict=one, f_vollimits=one,
-        w_node_affinity=one, w_taint=one, w_img=one, w_least=one,
-        w_balanced=one, w_most=zero, w_interpod=one, w_even=one, w_ssel=one,
-    )
+    set at weight 1, MostAllocated off (algorithmprovider/defaults).
+    Strong-typed and cached: see strong_engine_config."""
+    global _DEFAULT_ECFG
+    if _DEFAULT_ECFG is None:
+        one, zero = 1.0, 0.0
+        _DEFAULT_ECFG = strong_engine_config(EngineConfig(
+            f_unsched=one, f_name=one, f_ports=one, f_node_affinity=one,
+            f_fit=one, f_taints=one, f_interpod=one, f_spread=one,
+            f_volrestrict=one, f_vollimits=one,
+            w_node_affinity=one, w_taint=one, w_img=one, w_least=one,
+            w_balanced=one, w_most=zero, w_interpod=one, w_even=one,
+            w_ssel=one,
+        ))
+    return _DEFAULT_ECFG
 
 
 def _on(flag: Array) -> Array:

@@ -279,6 +279,15 @@ class Proxier:
             # to program is healthy, not "never synced" (healthcheck.go
             # calls Updated() after each syncProxyRules)
             self.healthz.updated()
+            # …but updated() also CLEARS the queued-update stamp, and an
+            # event that arrived after this pass popped _pending is not
+            # programmed yet: re-stamp it, or a sync loop that wedges right
+            # after this pass would report 200 forever for a change it
+            # never programmed
+            with self._pending_mu:
+                still_pending = bool(self._pending)
+            if still_pending:
+                self.healthz.queued_update()
         return n
 
     def _conntrack_reconcile(self, ns: str, name: str,

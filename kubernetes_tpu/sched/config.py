@@ -123,7 +123,8 @@ class KubeSchedulerConfiguration:
         weights/flags (ops/lattice.py EngineConfig): a filter plugin absent
         from the set stops filtering; a score plugin absent scores 0; an
         enabled score plugin carries its configured weight."""
-        from ..ops.lattice import EngineConfig, default_engine_config
+        from ..ops.lattice import (
+            EngineConfig, default_engine_config, strong_engine_config)
 
         plugins = self.plugins or default_plugins()
         fset = set(plugins.filter.enabled)
@@ -133,7 +134,7 @@ class KubeSchedulerConfiguration:
             return float(self.score_weights.get(name, 1.0)) \
                 if name in sset else 0.0
 
-        return EngineConfig(
+        return strong_engine_config(EngineConfig(
             f_unsched=1.0 if "NodeUnschedulable" in fset else 0.0,
             f_name=1.0 if "NodeName" in fset else 0.0,
             f_ports=1.0 if "NodePorts" in fset else 0.0,
@@ -154,9 +155,9 @@ class KubeSchedulerConfiguration:
             w_even=w("PodTopologySpread"),
             w_ssel=max(w("SelectorSpread"), w("DefaultPodTopologySpread")),
             w_window=float(self.score_admission_window),
-        ) if (self.plugins is not None or self.score_weights) \
-            else default_engine_config()._replace(
-                w_window=float(self.score_admission_window))
+        )) if (self.plugins is not None or self.score_weights) \
+            else strong_engine_config(default_engine_config()._replace(
+                w_window=float(self.score_admission_window)))
 
     def build_framework(self) -> Framework:
         return Framework(

@@ -213,7 +213,9 @@ def _schedule_batch(tables, pending, keys, D, existing,
                     extra_plugins: tuple = (),
                     extra_weights: tuple = (),
                     gang=None,
-                    return_waves: bool = False):
+                    return_waves: bool = False,
+                    dims=None,
+                    prewarmer=None):
     engine = _engine()
     if gang is not None and engine != "scan" and not has_node_name \
             and pending.valid.shape[0] >= _GANG_HOST_THRESHOLD:
@@ -232,9 +234,25 @@ def _schedule_batch(tables, pending, keys, D, existing,
     # hardPodAffinitySymmetricWeight (apis/config/types.go:70) and the
     # EngineConfig plugin composition ride as traced f32 scalars so config
     # changes never recompile
+    from ..ops.lattice import strong_engine_config
+
+    ecfg = strong_engine_config(ecfg) if ecfg is not None \
+        else default_engine_config()
+    hw = jnp.float32(hard_weight)
+    if prewarmer is not None and dims is not None and not return_waves:
+        # prewarmed executable for this exact signature: calling the stored
+        # jax Compiled skips trace+lower+compile — the boundary cycle right
+        # after a capacity-bucket crossing stays in budget (sched/prewarm.py)
+        compiled = prewarmer.lookup(dims, engine, extra_plugins,
+                                    gang is not None)
+        if compiled is not None:
+            try:
+                return compiled(tables, pending, keys, existing, hw, ecfg,
+                                extra_weights, gang)
+            except TypeError:
+                pass  # aval/pytree drift — take the ordinary jit path
     return _schedule_batch_impl(tables, pending, keys, D, existing, engine,
-                                jnp.float32(hard_weight),
-                                ecfg or default_engine_config(),
+                                hw, ecfg,
                                 extra_plugins, extra_weights, gang,
                                 return_waves)
 

@@ -15,19 +15,30 @@ preempt again."""
 from __future__ import annotations
 
 import functools
-from typing import Callable, List, Optional
+import os
+from typing import Callable, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..api.types import Pod
-from ..ops.preempt import PreemptResult, preempt_for_pod
+from ..ops.preempt import PreemptResult, preempt_batch
 from ..state.cache import Snapshot
+
+# preemptor lanes per fused dispatch: bursts larger than this chunk. ONE
+# fixed size keeps the compile-signature count at one per Dims bucket (and
+# lets the prewarmer compile it ahead of the first storm); unused lanes are
+# padded with the last real preemptor and their results discarded.
+PREEMPT_BURST = int(os.environ.get("KTPU_PREEMPT_BURST", "8"))
 
 
 @functools.partial(jax.jit, static_argnums=(5,))
 def _preempt(tables, cyc_existing, cls, nnr, prio, D, keys, pdb_blocked,
              hard_weight, ecfg):
+    """One fused dispatch for a [B] burst of preemptors: build the cycle
+    lattice ONCE, evaluate every lane's five-criteria what-if in parallel
+    (ops/preempt.py preempt_batch). Prewarmable: sched/prewarm.py
+    abstract_preempt_args mirrors this signature."""
     from ..ops.lattice import build_cycle
 
     uk, ev = keys
@@ -35,8 +46,8 @@ def _preempt(tables, cyc_existing, cls, nnr, prio, D, keys, pdb_blocked,
     # the what-if must apply the SAME plugin composition as the live path —
     # a filter the config disabled must not block preemption candidates
     cyc = build_cycle(tables, existing, uk, ev, D, hard_weight, ecfg)
-    return preempt_for_pod(tables, cyc, existing, cls, nnr, prio, D,
-                           pdb_blocked)
+    return preempt_batch(tables, cyc, existing, cls, nnr, prio, D,
+                         pdb_blocked)
 
 
 class CacheEvictor:
@@ -98,6 +109,13 @@ class Preemptor:
         self.attempts = 0
         self.successes = 0
         self.last_pdb_violations = 0
+        # zero-victim prompt retries already granted, per pod key: the
+        # FIRST "candidate with zero victims" is almost always burst/wave
+        # staleness (state changed under the what-if) and retries promptly;
+        # a REPEAT is a real host/device filter discrepancy and must take
+        # the backoff + FailedScheduling path, or it would hot-loop at wave
+        # frequency invisibly
+        self._zero_victim_retries: dict = {}
 
     def _pdb_blocked(self, scheduler, snap: Snapshot):
         import numpy as np
@@ -130,71 +148,161 @@ class Preemptor:
 
     def try_preempt(self, scheduler, pod: Pod, attempts: int,
                     snap: Snapshot, now: float) -> bool:
-        """Returns True iff preemption was performed (victims evicted and the
-        pod nominated + requeued). False → caller handles the failure as a
-        plain unschedulable pod."""
-        if pod.priority <= 0:
-            return False  # only priority pods preempt (disablePreemption for
-                          # the rest is the config default behavior)
-        if scheduler.queue.nominated_node(pod.key) is not None:
-            # it failed even on its nominated node (someone stole the freed
-            # space) — clear the nomination so the next failure can preempt
-            # again (the reference clears Status.NominatedNodeName here)
-            scheduler.queue.delete_nominated(pod.key)
-            return False
-        self.attempts += 1
+        """Single-preemptor convenience (extender path, tests): a burst of
+        one. Returns True iff preemption was performed (victims evicted and
+        the pod nominated + requeued)."""
+        return pod.key in self.preempt_burst(
+            scheduler, [(pod, attempts)], snap, now)
 
-        # find this pod's row in the snapshot's pending arrays
-        try:
-            row = [k for k, _ in snap.pending_keys].index(pod.key)
-        except ValueError:
-            return False
+    def preempt_burst(self, scheduler, burst: Sequence[Tuple[Pod, int]],
+                      snap: Snapshot, now: float) -> Set[str]:
+        """The whole wave's preemption pass as ONE fused device dispatch
+        (chunked at PREEMPT_BURST lanes): evaluate every unschedulable
+        priority pod's what-if against the same snapshot, then commit
+        host-side in batch order. Returns the keys that preempted (victims
+        evicted, pod nominated + requeued); the caller requeues the rest as
+        plain unschedulable.
 
-        enc = scheduler.encoder
-        from .cycle import UNSCHEDULABLE_TAINT_KEY
-
-        uk = jnp.int32(enc.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
-        ev = jnp.int32(enc.vocabs.label_vals.get(""))
+        Commit semantics vs the old per-pod loop (which re-snapshotted
+        between pods): lanes are evaluated against the PRE-burst state, so
+        two lanes can name the same victim. The commit evicts each victim
+        once; a lane none of whose victims remain evictable is NOT counted
+        as preempting — its space was already freed by an earlier lane and
+        the ordinary retry (the eviction's move event) will place it."""
         import numpy as np
 
+        from ..ops.lattice import default_engine_config
+        from .cycle import UNSCHEDULABLE_TAINT_KEY
+
+        # ---- host-side eligibility (PodEligibleToPreemptOthers) ---- #
+        row_of = {k: i for i, (k, _) in enumerate(snap.pending_keys)}
+        eligible: List[Tuple[Pod, int, int]] = []  # (pod, attempts, row)
+        for pod, attempts in burst:
+            if pod.priority <= 0:
+                continue  # only priority pods preempt
+            if scheduler.queue.nominated_node(pod.key) is not None:
+                # it failed even on its nominated node (someone stole the
+                # freed space) — clear the nomination and re-evaluate in
+                # THIS burst. The reference defers re-preemption to the
+                # next failure because its victims exit asynchronously;
+                # our evictors remove victims synchronously, so a
+                # nominated pod failing again means the space is truly
+                # gone and the what-if against the fresh snapshot is the
+                # correct immediate response (parking it in backoff just
+                # serializes the storm at seconds per round).
+                scheduler.queue.delete_nominated(pod.key)
+            row = row_of.get(pod.key)
+            if row is None:
+                continue
+            eligible.append((pod, attempts, row))
+        if not eligible:
+            return set()
+        self.attempts += len(eligible)
+
+        enc = scheduler.encoder
+        uk = jnp.int32(enc.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+        ev = jnp.int32(enc.vocabs.label_vals.get(""))
         blocked = self._pdb_blocked(scheduler, snap)
         pdb_arr = np.zeros((snap.existing.valid.shape[0],), bool)
         pdb_arr[: blocked.shape[0]] = blocked
-        from ..ops.lattice import default_engine_config
+        pdb_dev = jnp.asarray(pdb_arr)
+        hw = jnp.float32(getattr(scheduler, "hard_pod_affinity_weight", 1.0))
+        ecfg = getattr(scheduler, "engine_config", None) \
+            or default_engine_config()
+        prewarmer = getattr(scheduler, "prewarmer", None)
 
-        res: PreemptResult = _preempt(
-            snap.tables, snap.existing,
-            snap.pending.cls[row], snap.pending.node_name_req[row],
-            jnp.int32(pod.priority), snap.dims.D, (uk, ev),
-            jnp.asarray(pdb_arr),
-            jnp.float32(getattr(scheduler, "hard_pod_affinity_weight", 1.0)),
-            getattr(scheduler, "engine_config", None)
-            or default_engine_config(),
-        )
-        node_idx = int(jax.device_get(res.node))
-        if node_idx < 0:
-            return False
+        pend_cls = np.asarray(jax.device_get(snap.pending.cls))
+        pend_nnr = np.asarray(jax.device_get(snap.pending.node_name_req))
 
-        victims_mask = jax.device_get(res.victims)
-        victim_keys = [
-            snap.existing_keys[i]
-            for i in range(min(len(snap.existing_keys), victims_mask.shape[0]))
-            if victims_mask[i]
-        ]
-        if not victim_keys:
-            # a candidate with zero victims means the pod should simply fit —
-            # evicting nothing and nominating would only mask a filter
-            # discrepancy; let the normal retry path handle it
-            return False
-        for vk in victim_keys:
-            self.evictor.evict(scheduler, vk)
+        handled: Set[str] = set()
+        retry_soon: Set[str] = set()  # candidates whose space another lane
+                                      # freed this burst: retry promptly
+        B = PREEMPT_BURST
+        for lo in range(0, len(eligible), B):
+            chunk = eligible[lo: lo + B]
+            pad = chunk + [chunk[-1]] * (B - len(chunk))
+            rows = [r for _, _, r in pad]
+            cls_b = jnp.asarray(pend_cls[rows], jnp.int32)
+            nnr_b = jnp.asarray(pend_nnr[rows], jnp.int32)
+            prio_b = jnp.asarray(
+                np.array([p.priority for p, _, _ in pad], np.int32))
+            compiled = prewarmer.lookup_preempt(snap.dims, B) \
+                if prewarmer is not None else None
+            res: PreemptResult
+            if compiled is not None:
+                try:
+                    res = compiled(snap.tables, snap.existing, cls_b, nnr_b,
+                                   prio_b, (uk, ev), pdb_dev, hw, ecfg)
+                except TypeError:
+                    compiled = None
+            if compiled is None:
+                res = _preempt(snap.tables, snap.existing, cls_b, nnr_b,
+                               prio_b, snap.dims.D, (uk, ev), pdb_dev, hw,
+                               ecfg)
+            nodes_b = np.asarray(jax.device_get(res.node))
+            victims_b = np.asarray(jax.device_get(res.victims))
+            npdb_b = np.asarray(jax.device_get(res.n_pdb_violations))
 
-        self.last_pdb_violations = int(jax.device_get(res.n_pdb_violations))
-        node_name = snap.node_order[node_idx]
-        scheduler.queue.add_nominated(pod.key, node_name)
-        # cache changed → move event; requeue the preemptor for a prompt retry
-        # (real attempt count preserved so exponential backoff keeps growing)
+            for lane, (pod, attempts, _row) in enumerate(chunk):
+                node_idx = int(nodes_b[lane])
+                if node_idx < 0:
+                    continue
+                victim_keys = [
+                    snap.existing_keys[i]
+                    for i in np.flatnonzero(
+                        victims_b[lane][: len(snap.existing_keys)])
+                ]
+                if not victim_keys:
+                    # a candidate with zero victims: the pod should simply
+                    # fit. Once per pod that is burst staleness (an earlier
+                    # lane/wave freed the space after the what-if's
+                    # snapshot) — retry promptly. A repeat means a real
+                    # host/device filter discrepancy: evicting nothing and
+                    # nominating would only mask it, so it takes the
+                    # normal backoff + FailedScheduling path.
+                    if self._zero_victim_retries.get(pod.key, 0) < 1:
+                        if len(self._zero_victim_retries) > 4096:
+                            # bound the ledger by dropping the OLDEST half
+                            # (dict preserves insertion order) — clearing
+                            # wholesale would forget the pod just recorded
+                            # and re-arm the hot loop this cap prevents
+                            for k in list(self._zero_victim_retries)[:2048]:
+                                del self._zero_victim_retries[k]
+                        self._zero_victim_retries[pod.key] = 1
+                        retry_soon.add(pod.key)
+                    continue
+                evicted_any = False
+                for vk in victim_keys:
+                    evicted_any |= self.evictor.evict(scheduler, vk)
+                if not evicted_any:
+                    # every victim was already evicted for an earlier lane:
+                    # that lane's commit freed this space — the pod is
+                    # expected to fit next wave; exponential backoff here
+                    # would serialize the whole burst at seconds per round
+                    retry_soon.add(pod.key)
+                    continue
+                self.last_pdb_violations = int(npdb_b[lane])
+                scheduler.queue.add_nominated(pod.key,
+                                              snap.node_order[node_idx])
+                handled.add(pod.key)
+                self._zero_victim_retries.pop(pod.key, None)
+                self.successes += 1
+
+        if not handled:
+            # no lane evicted anything: a zero-victim candidate here is a
+            # genuine filter discrepancy, not burst overlap — every pod
+            # takes the ordinary unschedulable/backoff path
+            return set()
+        # cache changed → move event for everyone else; the nominated
+        # preemptors (and the lanes whose space an earlier lane freed)
+        # go straight back to activeQ, attempt counts preserved: their
+        # next attempt is expected to succeed once the victims are
+        # gone, and serving the accumulated exponential backoff first
+        # would stall the burst for seconds per round
+        # (queue.add_prompt_retry's documented deviation)
         scheduler.queue.move_all_to_active(now)
-        scheduler.queue.add_unschedulable(pod, attempts=attempts, now=now)
-        self.successes += 1
-        return True
+        for pod, attempts, _row in eligible:
+            if pod.key in handled or pod.key in retry_soon:
+                scheduler.queue.add_prompt_retry(
+                    pod, attempts=attempts, now=now)
+        return handled | retry_soon

@@ -85,10 +85,57 @@ def abstract_cycle_args(d: Dims, gang: bool = False):
             gang_args)
 
 
+def abstract_preempt_args(d: Dims, burst: int):
+    """ShapeDtypeStruct pytrees for one sched.preemption._preempt call at
+    dims `d` with a preemptor burst of `burst` lanes — the preemption analog
+    of abstract_cycle_args, so the burst program can compile in the
+    background BEFORE the first preemption storm hits the live path."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.lattice import default_engine_config
+    from ..state.arrays import ClusterTables
+    from ..state.encode import Encoder
+
+    enc = Encoder()
+    tables = ClusterTables(
+        nodes=enc.empty_node_arrays(d),
+        reqs=enc.build_req_table(d),
+        labelsets=enc.build_labelset_table(d),
+        nterms=enc.build_nterm_table(d),
+        tolsets=enc.build_tolset_table(d),
+        portsets=enc.build_portset_table(d),
+        terms=enc.build_term_table(d),
+        classes=enc.build_class_table(d),
+        images=enc.build_image_table(d),
+        zone_keys=enc.build_zone_keys(),
+        volsets=enc.build_volset_table(d),
+        drv_masks=enc.build_drv_masks(d),
+    )
+    existing = enc.build_pod_arrays([], d, capacity=d.E)
+    abstract = lambda t: jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
+    scalar_i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    scalar_f32 = jax.ShapeDtypeStruct((), jnp.float32)
+    vec_i32 = jax.ShapeDtypeStruct((burst,), jnp.int32)
+    pdb = jax.ShapeDtypeStruct((d.E,), jnp.bool_)
+    return (abstract(tables), abstract(existing), vec_i32, vec_i32, vec_i32,
+            (scalar_i32, scalar_i32), pdb, scalar_f32,
+            jax.tree.map(lambda _: scalar_f32, default_engine_config()))
+
+
 class BucketPrewarmer:
     """Watches per-cycle occupancy and compiles the next bucket ahead of
     need. One in-flight compile at a time; each (dims, engine) signature is
-    warmed at most once per process."""
+    warmed at most once per process.
+
+    Compiled executables are KEPT (self.compiled) and the dispatch layer
+    calls them directly (`sched/cycle.py _schedule_batch`): re-tracing the
+    wave engine at a big shape costs seconds even with the persistent XLA
+    cache, which would blow the boundary-cycle budget right when the
+    cluster crosses a bucket. Calling the stored jax Compiled skips
+    trace+lower+compile entirely — the first post-boundary cycle pays only
+    the snapshot patch and the dispatch itself."""
 
     def __init__(self, threshold: float = 0.8, min_axis: int = 256,
                  compile_fn: Optional[Callable] = None):
@@ -99,11 +146,21 @@ class BucketPrewarmer:
 
         self.threshold = threshold
         self.min_axis = int(os.environ.get("KTPU_PREWARM_MIN_AXIS", min_axis))
+        self.enabled = True   # bench/test gate: observe() is a no-op when off
         self._warmed: set = set()
         self._mu = threading.Lock()
         self._inflight: Optional[threading.Thread] = None
+        # the preempt program warms on its OWN slot: a next-bucket cycle
+        # compile can run for the better part of a minute, and serializing
+        # behind it would leave the first preemption storm paying the
+        # burst compile synchronously (XLA compiles release the GIL, so
+        # two background compiles genuinely overlap)
+        self._inflight_preempt: Optional[threading.Thread] = None
         self._compile_fn = compile_fn or self._compile
         self.warm_log: list = []   # (dims, engine) actually compiled — tests
+        # (dims, engine, extras, gang) → jax Compiled for the cycle program;
+        # ("preempt", dims, burst) → Compiled for the preemption burst
+        self.compiled: dict = {}
 
     def observe(self, d: Dims, n_nodes: int, n_existing: int,
                 engine: str = "waves", extras: tuple = (),
@@ -113,6 +170,8 @@ class BucketPrewarmer:
         is near a boundary. Warms one target per call; multiple crossing
         axes warm on successive cycles (single-axis targets first — the
         common case is one axis crossing at a time — then the joint one)."""
+        if not self.enabled:
+            return
         live = {"N": n_nodes, "E": n_existing}
         crossing = [ax for ax in _GROWTH_AXES
                     if getattr(d, ax) >= self.min_axis
@@ -143,26 +202,91 @@ class BucketPrewarmer:
 
     def _compile(self, d: Dims, engine: str, extras: tuple,
                  gang: bool) -> None:
+        key = (replace(d, has_node_name=False), engine, extras, gang)
         try:
             from .cycle import _schedule_batch_impl
 
             (tables, pending, keys, existing, hw, ecfg,
              gang_args) = abstract_cycle_args(d, gang=gang)
-            _schedule_batch_impl.lower(
+            compiled = _schedule_batch_impl.lower(
                 tables, pending, keys, d.D, existing, engine, hw, ecfg,
                 extras, tuple(1.0 for _ in extras), gang_args,
             ).compile()
+            with self._mu:
+                self.compiled[key] = compiled
             self.warm_log.append((d, engine))
         except Exception:
             # prewarming is an optimization: a failed background compile
             # must never take down the scheduling loop; the live path will
             # compile on demand exactly as without a prewarmer
             with self._mu:
-                self._warmed.discard(
-                    (replace(d, has_node_name=False), engine, extras, gang))
+                self._warmed.discard(key)
+
+    def lookup(self, d: Dims, engine: str, extras: tuple, gang: bool):
+        """The stored Compiled for this cycle signature, or None. Called on
+        the dispatch hot path — one dict probe."""
+        return self.compiled.get(
+            (replace(d, has_node_name=False), engine, extras, gang))
+
+    # ---- preemption-burst program (sched/preemption.py _preempt) ---- #
+
+    @staticmethod
+    def _preempt_key(d: Dims, burst: int):
+        # the burst program never sees the pending arrays, so P (and the
+        # per-batch has_node_name flag) must not split the key: the warm
+        # happens against the WAVE snapshot's dims while the lookup uses
+        # the preemption pass's fresh snapshot — any P drift between the
+        # two would orphan the prewarmed executable exactly when a storm
+        # needs it
+        return ("preempt", replace(d, has_node_name=False, P=1), burst)
+
+    def observe_preempt(self, d: Dims, burst: int) -> None:
+        """Warm the preemption-burst program for the CURRENT dims in the
+        background. Unlike the cycle program (compiled by the first wave),
+        nothing compiles the preempt what-if until the first preemption
+        storm — which is exactly when a multi-second compile stall hurts
+        most. The scheduler calls this once per steady cycle; each
+        (dims, burst) signature compiles at most once."""
+        if not self.enabled:
+            return
+        if max(d.N, d.E) < self.min_axis:
+            return
+        key = self._preempt_key(d, burst)
+        with self._mu:
+            if key in self._warmed:
+                return
+            if self._inflight_preempt is not None \
+                    and self._inflight_preempt.is_alive():
+                return  # one preempt compile at a time; retry next cycle
+            self._warmed.add(key)
+            t = threading.Thread(
+                target=self._compile_preempt, args=(d, burst),
+                name=f"ktpu-prewarm-preempt-{d.N}x{d.E}", daemon=True)
+            self._inflight_preempt = t
+            t.start()
+
+    def _compile_preempt(self, d: Dims, burst: int) -> None:
+        key = self._preempt_key(d, burst)
+        try:
+            from .preemption import _preempt
+
+            (tables, existing, cls, nnr, prio, keys, pdb,
+             hw, ecfg) = abstract_preempt_args(d, burst)
+            compiled = _preempt.lower(
+                tables, existing, cls, nnr, prio, d.D, keys, pdb, hw, ecfg,
+            ).compile()
+            with self._mu:
+                self.compiled[key] = compiled
+            self.warm_log.append((d, "preempt"))
+        except Exception:
+            with self._mu:
+                self._warmed.discard(key)
+
+    def lookup_preempt(self, d: Dims, burst: int):
+        return self.compiled.get(self._preempt_key(d, burst))
 
     def wait(self, timeout: Optional[float] = None) -> None:
-        """Test/shutdown helper: join the in-flight compile."""
-        t = self._inflight
-        if t is not None:
-            t.join(timeout)
+        """Test/shutdown helper: join the in-flight compiles."""
+        for t in (self._inflight, self._inflight_preempt):
+            if t is not None:
+                t.join(timeout)

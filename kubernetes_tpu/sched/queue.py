@@ -164,6 +164,39 @@ class PriorityQueue:
                 out.append((e.pod, e.attempts))
         return out
 
+    def add_prompt_retry(self, pod: Pod, attempts: int,
+                         now: float = 0.0) -> None:
+        """Requeue straight to activeQ, KEEPING the attempt count — for
+        preemptors that just got a node nominated: their next attempt is
+        expected to succeed the moment the victims exit, and serving the
+        accumulated exponential backoff first (1 s, 2 s, 4 s…) only delays
+        reuse of space already evicted for them (documented deviation,
+        docs/PERF.md round 6: the reference routes them through backoffQ).
+        Spin safety lives in sched/preemption.py: a retried pod that finds
+        NO preemption candidate takes the ordinary backoff path, and the
+        zero-victim (filter-discrepancy) case gets at most one prompt
+        retry per pod (Preemptor._zero_victim_retries)."""
+        with self._mu:
+            if pod.key in self._active_keys or pod.key in self._backoff_keys:
+                return
+            self._unschedulable.pop(pod.key, None)
+            e = _Entry(pod=pod, attempts=attempts, timestamp=now)
+            self._push_active(e)
+
+    def peek_active(self, max_n: int) -> List[Pod]:
+        """Non-destructive view of up to max_n pods waiting in activeQ (heap
+        order, approximately). The scheduler's double-buffer uses this to
+        intern the NEXT wave's pods while the device evaluates the current
+        one — order does not matter for interning, so no heap pop/repair."""
+        out: List[Pod] = []
+        with self._mu:
+            for _, _, _, e in self._active:
+                if self._active_keys.get(e.pod.key) is e:
+                    out.append(e.pod)
+                    if len(out) >= max_n:
+                        break
+        return out
+
     def wait_nonempty(self, timeout: Optional[float] = None) -> bool:
         """Block until activeQ is non-empty (the reference's Pop blocks on a
         condition variable, scheduling_queue.go Pop); the wave driver then

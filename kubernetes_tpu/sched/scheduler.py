@@ -263,7 +263,22 @@ class Scheduler:
                               ecfg=self.engine_config,
                               extra_plugins=extras,
                               extra_weights=tuple(w for _, w in self._extra_score),
-                              gang=snap.gang if self._device_gangs else None)
+                              gang=snap.gang if self._device_gangs else None,
+                              dims=snap.dims, prewarmer=self.prewarmer)
+        # ---- double-buffered host/device overlap: the dispatch above is
+        # asynchronous, so while the device evaluates THIS wave, the host
+        # interns the NEXT wave's backlog (the dominant host cost of the
+        # next snapshot). By the time device_get blocks, cycle N+1's pod
+        # rows are already memoized — encode of N+1 overlapped dispatch of N.
+        if self.preemptor is not None:
+            from .preemption import PREEMPT_BURST
+
+            # preemption storms compile their own fused program: warm it in
+            # the background at the current dims before the first storm
+            self.prewarmer.observe_preempt(snap.dims, PREEMPT_BURST)
+        backlog = self.queue.peek_active(self.batch_size)
+        if backlog:
+            self.encoder.intern_pods(backlog)
         node_idx = jax.device_get(res.node)
 
         failures: List[Tuple[Pod, int]] = []
@@ -280,25 +295,32 @@ class Scheduler:
             node_name = snap.node_order[ni]
             self._commit(pod, node_name, attempts, now, cycle, stats)
 
-        # ---- preemption pass: AFTER commits, against a FRESH snapshot so the
-        # what-if sees pods assumed earlier in this very wave (otherwise a
-        # preemptor could evict victims for space the wave already consumed)
-        for pod, attempts in failures:
-            handled = False
+        # ---- preemption pass: AFTER commits, against ONE fresh snapshot so
+        # the what-if sees pods assumed earlier in this very wave (otherwise
+        # a preemptor could evict victims for space the wave already
+        # consumed). The whole burst of unschedulable pods is evaluated in a
+        # single fused dispatch (sched/preemption.py preempt_burst) instead
+        # of one snapshot+dispatch per pod.
+        handled_keys: set = set()
+        if failures and self.preemptor is not None:
             # gang pods never preempt individually: evicting victims to place
             # ONE member of a group whose admission is all-or-nothing would
             # trade running pods for a pod that may never commit (the
             # coscheduling ecosystems gate preemption on the whole group)
-            if self.preemptor is not None and not pod.pod_group:
+            eligible = [(p, a) for p, a in failures if not p.pod_group]
+            if eligible:
                 fresh = self.cache.snapshot(
                     self.encoder, [p for p, _ in failures], self.base_dims,
                     extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
                 )
-                handled = self.preemptor.try_preempt(self, pod, attempts, fresh, now)
-            if not handled:
-                stats.unschedulable += 1
-                stats.failed_keys.append(pod.key)
-                self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
+                handled_keys = self.preemptor.preempt_burst(
+                    self, eligible, fresh, now)
+        for pod, attempts in failures:
+            if pod.key in handled_keys:
+                continue
+            stats.unschedulable += 1
+            stats.failed_keys.append(pod.key)
+            self.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
 
         for pod, attempts in ext_batch:
             self._schedule_one_with_extenders(pod, attempts, now, cycle, stats)

@@ -331,7 +331,15 @@ class SchedulerServer:
             with self._mu:
                 pending = self.scheduler.queue.lengths()[0]
             if pending and self.batch_window:
-                self._stop.wait(self.batch_window)  # let the batch fill
+                # coalesce STORMS into few large waves with the full
+                # window; a small pending set (a preemption retry burst, a
+                # gang trickling in over milliseconds) gets a SHORT wait —
+                # enough to gather co-created pods into one all-or-nothing
+                # wave, without the full window's latency tax on every
+                # tiny wave (the r5 preempt burst spent ~1 s just waiting)
+                w = self.batch_window if pending >= 32 \
+                    else min(0.05, self.batch_window)
+                self._stop.wait(w)  # let the batch fill
             stats = self.run_one_wave()
             if stats is None or stats.attempted == 0:
                 self._stop.wait(self.cycle_interval)

@@ -371,26 +371,41 @@ class SchedulerCache:
             for s in extra_intern:
                 encoder.vocabs.label_keys.intern(s)
             projection_widened = False
+            converged = False
             for _walk_pass in range(8):  # referenced keys grow monotonically
-                for p in pending:
-                    encoder.pod_row(p)  # memoized: O(new), registers classes
+                encoder.intern_pods(pending)  # memoized batch: O(new)
                 if (self._staging_nodes is None
                         or self._encoder is not encoder
                         or projection_widened):
-                    for st in self._pods.values():  # cold: walk everything
-                        encoder.pod_row(st.pod)
+                    # cold: walk everything (batch path)
+                    encoder.intern_pods(
+                        [st.pod for st in self._pods.values()])
                 else:
-                    for p in self._dirty_pods.values():
-                        if p is not None:
-                            encoder.pod_row(p)   # steady state: O(changed)
+                    encoder.intern_pods(
+                        [p for p in self._dirty_pods.values()
+                         if p is not None])   # steady state: O(changed)
                 if not encoder.classes_stale:
+                    converged = True
                     break
                 # a selector referenced a new pod-label key mid-walk:
                 # projected class identities (encode.py class_id) changed
                 # for every pod — drop memos, re-walk ALL pods, and force
-                # the full snapshot path (staged rows hold old class ids)
+                # the full snapshot path (staged rows hold old class ids).
+                # projection_rewalk clears classes_stale, so convergence is
+                # tracked via the flag above, not re-checked after the loop.
                 encoder.projection_rewalk()
                 projection_widened = True
+            if not converged:
+                # an unconverged projection means staged class ids are
+                # stale — a snapshot built now would schedule against the
+                # wrong classes. Fail loud (encode.ProjectionUnconvergedError
+                # semantics) instead of silently mis-placing.
+                from .encode import ProjectionUnconvergedError
+
+                raise ProjectionUnconvergedError(
+                    "label projection did not converge after 8 re-walk "
+                    "passes; "
+                    f"{len(encoder.referenced_label_keys)} referenced keys")
             for name in self._dirty_nodes:
                 n = self._nodes.get(name)
                 if n is not None:

@@ -54,6 +54,15 @@ ZONE_TOPO_KEYS = ("topology.kubernetes.io/zone",
                   "failure-domain.beta.kubernetes.io/zone")
 
 
+class ProjectionUnconvergedError(RuntimeError):
+    """The selector→label projection re-walk failed to reach a fixpoint:
+    every pass referenced yet another pod-label key. Encoding would produce
+    stale class ids (silently wrong placements), so the snapshot build
+    raises instead. In practice this means a pathological workload keeps
+    introducing selectors over never-before-seen keys faster than the walk
+    converges — surface it to the operator rather than mis-schedule."""
+
+
 def _set_bit(words: np.ndarray, idx: int) -> None:
     words[idx >> 5] |= U32(1) << U32(idx & 31)
 
@@ -104,7 +113,17 @@ class Encoder:
         # class is potentially split: `classes_stale` tells the cache to
         # clear row memos and re-walk (SchedulerCache.snapshot).
         self.referenced_label_keys: set = set()   # label-key vocab ids
+        self.referenced_label_strs: set = set()   # the same keys, as strings
         self.classes_stale = False
+        # value-based class memo: spec fingerprint (namespace id + the raw
+        # field values class_id would walk) → class id. This is the batch-
+        # ingest fast path: template-stamped pods (Deployments, gang jobs)
+        # produce value-equal specs in FRESH objects per informer event, so
+        # identity memos miss but this hits — the full class_id walk then
+        # runs once per distinct template, not once per pod. Invalidated
+        # with the row memos when the label projection widens
+        # (projection_rewalk): fingerprints embed the projected label set.
+        self._class_memo: Dict[tuple, int] = {}
         # incremental-encode state (the cache.go:204-255 analog's host half):
         # per-object memos so steady-state cycles do O(changed) interning work.
         self._pod_rows: Dict[int, tuple] = {}   # id(pod) → (pod, row tuple)
@@ -226,6 +245,7 @@ class Encoder:
                 # a new pod-label key is now selector-visible: projected
                 # class identities must be recomputed (see __init__ note)
                 self.referenced_label_keys.add(kid)
+                self.referenced_label_strs.add(r.key)
                 self.classes_stale = True
             vids = tuple(sorted(self.vocabs.label_vals.intern(v) for v in r.values))
             reqs.append((kid, int(r.op), vids))
@@ -274,6 +294,7 @@ class Encoder:
         the owner re-walks every pod under the widened projection."""
         self.classes_stale = False
         self._pod_rows.clear()
+        self._class_memo.clear()
 
     def _projected_labels(self, labels: Dict[str, str]) -> Dict[str, str]:
         if not labels:
@@ -281,6 +302,45 @@ class Encoder:
         ref = self.referenced_label_keys
         get = self.vocabs.label_keys.get
         return {k: v for k, v in labels.items() if get(k) in ref}
+
+    def class_fingerprint(self, p: Pod, ns_id: int) -> tuple:
+        """Value-based spec fingerprint: equal fingerprints ⇒ class_id would
+        intern the same spec tuple. Built from raw field VALUES (everything
+        class_id walks), with two costs avoided on the template-stamped hot
+        path: labels collapse to the projected subset (unreferenced keys
+        cannot enter class identity, see __init__), and an all-empty
+        Affinity collapses to None so the per-pod fresh Affinity object
+        never pays a Python dataclass hash/eq."""
+        ref = self.referenced_label_strs
+        labels = p.labels
+        lk = tuple(sorted(
+            (k, v) for k, v in labels.items() if k in ref)) \
+            if (ref and labels) else ()
+        aff = p.affinity
+        if (aff.node_required is None and not aff.node_preferred
+                and not aff.pod_required and not aff.anti_required
+                and not aff.pod_preferred and not aff.anti_preferred):
+            aff = None
+        r = p.requests
+        nsel = p.node_selector
+        lim = p.limits
+        return (ns_id, r.milli_cpu, r.memory_kib, r.ephemeral_kib, r.scalars,
+                lk, tuple(sorted(nsel.items())) if nsel else None, aff,
+                p.tolerations, p.host_ports, p.topology_spread,
+                p.spread_selectors, p.images,
+                lim if (lim.milli_cpu or lim.memory_kib) else None,
+                p.volumes)
+
+    def class_id_memo(self, p: Pod, ns_id: int) -> int:
+        """class_id through the value-based fingerprint memo: the full spec
+        walk runs once per distinct template, not once per pod."""
+        key = self.class_fingerprint(p, ns_id)
+        cid = self._class_memo.get(key)
+        if cid is None:
+            cid = self.class_id(p)
+            _evict_half(self._class_memo, 1 << 16)
+            self._class_memo[key] = cid
+        return cid
 
     def class_id(self, p: Pod) -> int:
         ns_id = self.vocabs.namespaces.intern(p.namespace)
@@ -376,10 +436,11 @@ class Encoder:
             # bucket, and gang ids beyond it clip-collided (wrong all-or-
             # nothing accounting for every group past the capacity)
             self.group_id(p)
+        ns_id = self.vocabs.namespaces.intern(p.namespace)
         row = (
             self.vocabs.pod_names.intern(p.name),
-            self.vocabs.namespaces.intern(p.namespace),
-            self.class_id(p),
+            ns_id,
+            self.class_id_memo(p, ns_id),
             p.priority,
             p.creation_index,
             self.vocabs.node_names.intern(p.node_name) if p.node_name else -1,
@@ -387,6 +448,93 @@ class Encoder:
         _evict_half(self._pod_rows, 1 << 19)
         self._pod_rows[id(p)] = (p, row)
         return row
+
+    def intern_pods(self, pods) -> None:
+        """Batch ingest: the vectorized (columnar) analog of calling pod_row
+        per pod. One tight loop with hoisted lookups interns the whole event
+        batch — per-pod cost collapses to a fingerprint probe + a name
+        intern; the full object-graph walk (class_id) runs once per distinct
+        template. Fills the same per-object row memo pod_row reads, so
+        build_pod_arrays / encode_node_row afterwards are pure memo lookups.
+
+        Callers with selector-bearing workloads must keep the classes_stale
+        re-walk loop (encode_cluster, SchedulerCache.snapshot): a selector
+        referencing a new pod-label key mid-batch widens the projection and
+        invalidates earlier rows, exactly as in the per-pod path."""
+        pod_rows = self._pod_rows
+        names_fwd = self.vocabs.pod_names._fwd
+        names_rev = self.vocabs.pod_names._rev
+        ns_intern = self.vocabs.namespaces.intern
+        nn_intern = self.vocabs.node_names.intern
+        class_memo = self._class_memo
+        class_id = self.class_id
+        ref = self.referenced_label_strs
+        group_memo: Dict[object, Tuple[int, bool]] = {}
+        group_min = self.group_min
+        group_spec = self.group_spec
+        ns_cache: Dict[str, int] = {}
+        for p in pods:
+            ent = pod_rows.get(id(p))
+            if ent is not None and ent[0] is p:
+                continue
+            ns = p.namespace
+            nsid = ns_cache.get(ns)
+            if nsid is None:
+                nsid = ns_cache[ns] = ns_intern(ns)
+            gk = p.pod_group
+            if gk:
+                # relative group names are namespaced (Pod.group_key)
+                mk = gk if "/" in gk else (ns, gk)
+                gent = group_memo.get(mk)
+                if gent is None:
+                    key = gk if "/" in gk else ns + "/" + gk
+                    g = self.pod_groups.intern(key)
+                    spec = group_spec.get(key)
+                    if spec is not None:
+                        group_min[g] = spec
+                    gent = group_memo[mk] = (g, spec is not None)
+                g, pinned = gent
+                if not pinned:
+                    mm = p.min_member
+                    if mm > group_min.get(g, 0):
+                        group_min[g] = mm
+            # ---- class_fingerprint, inlined: this loop is the ingest hot
+            # path and the method-call + re-hoisting overhead is measurable
+            # at 100k pods/batch. KEEP IN SYNC with class_fingerprint.
+            labels = p.labels
+            lk = tuple(sorted(
+                (k, v) for k, v in labels.items() if k in ref)) \
+                if (ref and labels) else ()
+            aff = p.affinity
+            if (aff.node_required is None and not aff.node_preferred
+                    and not aff.pod_required and not aff.anti_required
+                    and not aff.pod_preferred and not aff.anti_preferred):
+                aff = None
+            r = p.requests
+            nsel = p.node_selector
+            lim = p.limits
+            fp = (nsid, r.milli_cpu, r.memory_kib, r.ephemeral_kib,
+                  r.scalars, lk,
+                  tuple(sorted(nsel.items())) if nsel else None, aff,
+                  p.tolerations, p.host_ports, p.topology_spread,
+                  p.spread_selectors, p.images,
+                  lim if (lim.milli_cpu or lim.memory_kib) else None,
+                  p.volumes)
+            cid = class_memo.get(fp)
+            if cid is None:
+                cid = class_id(p)
+                class_memo[fp] = cid
+            name = p.name
+            nid = names_fwd.get(name)
+            if nid is None:
+                nid = names_fwd[name] = len(names_rev)
+                names_rev.append(name)
+            nn = p.node_name
+            row = (nid, nsid, cid, p.priority, p.creation_index,
+                   nn_intern(nn) if nn else -1)
+            pod_rows[id(p)] = (p, row)
+        _evict_half(pod_rows, 1 << 19)
+        _evict_half(class_memo, 1 << 16)
 
     def rebuild_domain_maps(self, nodes: Sequence[Node]) -> None:
         """Compact the per-topology-key domain maps to the LIVE node set.
@@ -894,15 +1042,27 @@ class Encoder:
         all tables. Returns (tables, existing_pods, pending_pods, dims)."""
         for n in nodes:
             self.intern_node(n)
+        all_pods = list(existing) + list(pending)
+        converged = False
         for _walk_pass in range(8):  # referenced keys grow monotonically
-            for p in list(existing) + list(pending):
-                self.pod_row(p)
+            self.intern_pods(all_pods)
             if not self.classes_stale:
+                converged = True
                 break
             # a selector referenced a new pod-label key mid-walk: class
             # projections changed — re-walk under the widened projection
-            # (the cache path does the same in SchedulerCache.snapshot)
+            # (the cache path does the same in SchedulerCache.snapshot).
+            # NOTE: projection_rewalk clears classes_stale, so convergence
+            # must be tracked HERE — the flag cannot be re-checked after
+            # the loop.
             self.projection_rewalk()
+        if not converged:
+            # every pass widened the projection: building tables now would
+            # bake stale class ids into device rows (wrong placements).
+            # Fail loud instead of mis-scheduling silently.
+            raise ProjectionUnconvergedError(
+                "label projection did not converge after 8 re-walk passes; "
+                f"{len(self.referenced_label_keys)} referenced keys")
         d = self.dims(len(nodes), len(existing), len(pending), nodes, base)
         node_index = {n.name: i for i, n in enumerate(nodes)}
         tables = ClusterTables(

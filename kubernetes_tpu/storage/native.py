@@ -43,13 +43,13 @@ class CompactedError(Exception):
     """Watch/list from a revision older than the compaction point."""
 
 
-def _build_lib() -> Optional[str]:
+def _build_lib(force: bool = False) -> Optional[str]:
     so = os.path.join(_NATIVE_DIR, "libkvstore.so")
-    if os.path.exists(so):
+    if os.path.exists(so) and not force:
         return so
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
+        cmd = ["make", "-C", _NATIVE_DIR] + (["-B"] if force else [])
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return so if os.path.exists(so) else None
     except Exception:
         return None
@@ -69,7 +69,21 @@ def _load_lib() -> Optional[ctypes.CDLL]:
         so = _build_lib()
         if not so:
             return None
-        lib = ctypes.CDLL(so)
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            # a prebuilt .so linked against a NEWER libc than this host
+            # (GLIBC_2.34-style version errors) raises at dlopen time, not
+            # at build time: rebuild against the local toolchain once, and
+            # if that fails too fall back to the pure-Python store instead
+            # of poisoning every Store construction with an OSError
+            so = _build_lib(force=True)
+            if not so:
+                return None
+            try:
+                lib = ctypes.CDLL(so)
+            except OSError:
+                return None
         lib.kv_new.restype = ctypes.c_void_p
         lib.kv_free.argtypes = [ctypes.c_void_p]
         for fn, args, res in [

@@ -336,9 +336,15 @@ class TestEndpointsAndServices:
                          "labels": {"app": "web"}},
             "spec": {"containers": [{"name": "c", "image": "i"}], "nodeName": "n1"}})
         mark_pods_running(client, selector="app=web")
-        assert wait_for(lambda: (client.endpoints.get("web")
-                                 .get("subsets") or [{}])[0].get("addresses"),
-                        timeout=30)
+
+        def ready_addresses():
+            try:
+                ep = client.endpoints.get("web")
+            except errors.StatusError:
+                return None  # controller has not created the object yet
+            return (ep.get("subsets") or [{}])[0].get("addresses")
+
+        assert wait_for(ready_addresses, timeout=30)
         ep = client.endpoints.get("web")
         assert ep["subsets"][0]["addresses"][0]["targetRef"]["name"] == "w1"
         assert ep["subsets"][0]["ports"][0]["port"] == 8080
