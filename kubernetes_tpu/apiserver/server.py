@@ -398,6 +398,16 @@ def handle_rest(api: APIServer, method: str, path: str,
     watches per event). Mutations are audited here too (stage
     ResponseComplete, both outcomes) — the reference's audit filter sits in
     the same position in the handler chain."""
+    from kubernetes_tpu.utils import faultline
+
+    if faultline.should("apiserver.restart", "handle_rest"):
+        # chaos: the apiserver process dies and comes back between two
+        # requests. Storage (etcd) survives; every open watch connection
+        # does not — reflectors must re-establish/relist — and THIS request
+        # is the one that hit the connection-refused window.
+        api.storage.drop_watchers()
+        raise errors.new_service_unavailable(
+            "apiserver restarting (chaos-injected)")
     entry = None
     if api.crd_conversions:
         entry, want = _conversion_for(api, path)

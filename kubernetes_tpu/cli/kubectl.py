@@ -286,6 +286,36 @@ class Kubectl:
                                f"{meta.name(obj)} created\n")
         return 0
 
+    def patch_cmd(self, resource: str, name: str, patch_str: str,
+                  patch_type: str = "strategic",
+                  namespace: str = "default") -> int:
+        """kubectl patch (staging/src/k8s.io/kubectl/pkg/cmd/patch): apply a
+        strategic-merge (default), RFC 7386 merge, or RFC 6902 json patch
+        through the server's PATCH dialects (apiserver/registry.py patch —
+        machinery/strategicpatch.py implements all three)."""
+        rc = self._rc(resource)
+        try:
+            body = json.loads(patch_str)
+        except json.JSONDecodeError:
+            # kubectl accepts YAML patch bodies too (-p 'spec:\n  replicas: 3')
+            try:
+                body = yaml.safe_load(patch_str)
+            except yaml.YAMLError:
+                raise errors.new_bad_request(
+                    f"unable to parse patch {patch_str!r}: not JSON or YAML"
+                ) from None
+        if patch_type == "json":
+            if not isinstance(body, list):
+                raise errors.new_bad_request(
+                    "a json patch body must be an array of operations")
+        elif not isinstance(body, dict):
+            raise errors.new_bad_request(
+                f"a {patch_type} patch body must be a JSON object")
+        rc.patch(name, body, namespace if rc.namespaced else "",
+                 patch_type=patch_type)
+        self.out.write(f"{rc.resource.rstrip('s')}/{name} patched\n")
+        return 0
+
     def delete(self, resource: str, name: str,
                namespace: str = "default") -> int:
         rc = self._rc(resource)
@@ -648,6 +678,13 @@ def build_parser() -> argparse.ArgumentParser:
     ro.add_argument("--to-revision", type=int, default=0)
     ro.add_argument("--timeout", type=float, default=60.0)
 
+    pa = sub.add_parser("patch")
+    pa.add_argument("resource")
+    pa.add_argument("name")
+    pa.add_argument("-p", "--patch", required=True)
+    pa.add_argument("--type", default="strategic", dest="patch_type",
+                    choices=["strategic", "merge", "json"])
+
     de = sub.add_parser("delete")
     de.add_argument("resource")
     de.add_argument("name")
@@ -700,6 +737,9 @@ def main(argv: Optional[List[str]] = None, client: Optional[Client] = None,
             return k.rollout(args.subverb, args.target, args.namespace,
                              to_revision=args.to_revision,
                              timeout=args.timeout)
+        if args.verb == "patch":
+            return k.patch_cmd(args.resource, args.name, args.patch,
+                               args.patch_type, args.namespace)
         if args.verb == "delete":
             return k.delete(args.resource, args.name, args.namespace)
         if args.verb == "scale":

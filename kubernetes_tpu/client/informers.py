@@ -16,10 +16,40 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.machinery import errors, meta
 from kubernetes_tpu.machinery import watch as mwatch
+from kubernetes_tpu.machinery.wait import Backoff
 from kubernetes_tpu.client.rest import ResourceClient
+from kubernetes_tpu.utils import faultline
 
 Obj = Dict[str, Any]
 IndexFn = Callable[[Obj], List[str]]
+
+
+class RelistBackoff:
+    """Failure-counting wrapper around machinery/wait.Backoff for reflector
+    relists.
+
+    The reference reflector retries ListAndWatch through a backoff manager
+    (reflector.go:187 + wait.Backoff); a fixed 0.5 s cadence means a
+    compaction storm — every resume earning a fresh 410 — has N informers
+    hammering the apiserver at 2 Hz each, exactly when it is busiest. Delays
+    double per consecutive failed round, jittered, clamped to `cap` so a
+    fleet of reflectors doesn't relist in lockstep."""
+
+    def __init__(self, base: float = 0.5, cap: float = 30.0,
+                 factor: float = 2.0, jitter: float = 0.5):
+        self.base = base
+        self.cap = cap
+        self._b = Backoff(base=base, factor=factor, max_delay=cap,
+                          jitter=jitter)
+        self.attempts = 0
+
+    def next(self) -> float:
+        d = self._b.delay(self.attempts)
+        self.attempts += 1
+        return d
+
+    def reset(self) -> None:
+        self.attempts = 0
 
 
 class Indexer:
@@ -136,7 +166,11 @@ class SharedInformer:
         self.field_selector = field_selector
         self.indexer = Indexer(index_fns)
         self.lister = Lister(self.indexer)
-        self.relist_backoff = relist_backoff
+        self.relist_backoff = relist_backoff  # base delay (back-compat name)
+        self.backoff = RelistBackoff(base=relist_backoff)
+        # a round that survived this long was healthy: reset the ladder so
+        # one transient blip after a quiet hour doesn't start at the cap
+        self._backoff_reset_after = max(5.0, 4 * relist_backoff)
         self._handlers: List[Tuple[Callable, Callable, Callable]] = []
         self._handler_mu = threading.Lock()
         self._stop = threading.Event()
@@ -185,11 +219,14 @@ class SharedInformer:
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            t0 = time.monotonic()
             try:
                 self._list_and_watch()
             except Exception:  # noqa: BLE001 — reflector retries everything
                 pass
-            if self._stop.wait(self.relist_backoff):
+            if time.monotonic() - t0 >= self._backoff_reset_after:
+                self.backoff.reset()  # the round was healthy for a while
+            if self._stop.wait(self.backoff.next()):
                 return
 
     def _list_and_watch(self) -> None:
@@ -260,6 +297,13 @@ class SharedInformer:
                     if ev.type == mwatch.ERROR:
                         # 410 Gone → relist from scratch (reflector relist)
                         return
+                    if faultline.should("watch.drop", "informer"):
+                        # chaos: the stream dies mid-flight and THIS event
+                        # is lost with it — the resume from last_sync_rv
+                        # (which has not advanced past it) must redeliver
+                        break
+                    if faultline.should("watch.relist", "informer"):
+                        return  # chaos: 410-equivalent → full relist
                     self._dispatch(ev)
                     self.last_sync_rv = meta.resource_version(ev.object) or \
                         self.last_sync_rv

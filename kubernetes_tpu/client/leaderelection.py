@@ -14,6 +14,12 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from kubernetes_tpu.machinery import errors, meta
+from kubernetes_tpu.machinery.wait import jittered
+
+# client-go leaderelection.JitterFactor: each retry sleeps
+# retry_period × [1, 1 + JITTER) so a fleet of candidates doesn't CAS the
+# same Lease in lockstep every period
+JITTER = 0.2
 
 
 @dataclass
@@ -103,15 +109,59 @@ class LeaderElector:
             self._observed_leader = leader
             self.cfg.on_new_leader(leader)
 
+    def _release(self) -> bool:
+        """Release the Lease on graceful stop (client-go le.release()):
+        zero renewTime and clear the holder via a CAS update, so the next
+        candidate acquires immediately instead of waiting out a full
+        lease_duration of a holder that is already gone."""
+        leases = self.client.leases
+        try:
+            lease = leases.get(self.cfg.lock_name, self.cfg.lock_namespace)
+        except errors.StatusError:
+            return False
+        spec = lease.get("spec", {})
+        if spec.get("holderIdentity", "") != self.cfg.identity:
+            return False  # not ours (lost it already) — never stomp a peer
+        lease["spec"] = {
+            "holderIdentity": "",
+            "leaseDurationSeconds": 1,
+            "renewTime": 0,
+            "acquireTime": 0,
+            "leaseTransitions": int(spec.get("leaseTransitions", 0)),
+        }
+        try:
+            # resourceVersion rides along from the get → the update is a CAS:
+            # if a peer claimed the lease in between, the write conflicts and
+            # their claim stands
+            leases.update(lease, self.cfg.lock_namespace)
+            return True
+        except errors.StatusError:
+            return False
+
+    def _jittered(self, period: float) -> float:
+        return jittered(period, JITTER)
+
     # -- run loop (leaderelection.go Run: acquire → renew → lost) ----------- #
 
     def run(self) -> None:
+        try:
+            self._run_loop()
+        finally:
+            # the release belongs to the thread that can still be renewing:
+            # stop()'s own release can race an in-flight acquire/renew here
+            # (release lands, THIS thread's CAS then re-acquires the freshly
+            # cleared lease, and the process exits holding it). Releasing on
+            # loop exit closes that window; _release() no-ops unless the
+            # lease carries our identity.
+            self._release()
+
+    def _run_loop(self) -> None:
         while not self._stop.is_set():
             # acquire phase
             while not self._stop.is_set():
                 if self._try_acquire_or_renew():
                     break
-                if self._stop.wait(self.cfg.retry_period):
+                if self._stop.wait(self._jittered(self.cfg.retry_period)):
                     return
             if self._stop.is_set():
                 return
@@ -124,7 +174,7 @@ class LeaderElector:
                     deadline = time.monotonic() + self.cfg.renew_deadline
                 elif time.monotonic() > deadline:
                     break  # failed to renew in time → lost leadership
-                if self._stop.wait(self.cfg.retry_period):
+                if self._stop.wait(self._jittered(self.cfg.retry_period)):
                     break
             self._leading.clear()
             self.cfg.on_stopped_leading()
@@ -137,11 +187,21 @@ class LeaderElector:
 
     def stop(self) -> None:
         self._stop.set()
+        thread_done = True
         if self._thread is not None:
             self._thread.join(timeout=3)
+            thread_done = not self._thread.is_alive()
         if self._leading.is_set():
             self._leading.clear()
             self.cfg.on_stopped_leading()
+        # graceful handoff: failover shouldn't wait out lease_duration.
+        # Released here only once the run thread has actually exited — a
+        # still-running thread could re-acquire right after our release
+        # (its in-flight CAS sees the cleared holder) and orphan the lease;
+        # in that case run()'s own on-exit release is the one that counts.
+        # _release() no-ops unless the Lease carries OUR identity.
+        if thread_done:
+            self._release()
 
     @property
     def is_leader(self) -> bool:

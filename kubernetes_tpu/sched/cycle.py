@@ -29,15 +29,27 @@ from ..state.encode import Encoder
 UNSCHEDULABLE_TAINT_KEY = "node.kubernetes.io/unschedulable"  # predicates.go:1522-1541
 
 
-def snapshot_with_keys(cache, encoder: Encoder, pending, base_dims):
+def snapshot_with_keys(cache, encoder: Encoder, pending, base_dims,
+                       device=None):
     """Snapshot + the interned synthetic-taint key ids every device dispatch
     needs — the single home for the UNSCHEDULABLE_TAINT_KEY interning ritual
-    (shared by the scheduler wave path and the extender backend)."""
+    (shared by the scheduler wave path and the extender backend). `device`
+    routes the arrays to an explicit placement (the supervisor's degraded
+    mode: everything onto the CPU fallback, nothing on the lost backend)."""
     snap = cache.snapshot(encoder, pending, base_dims,
-                          extra_intern=(UNSCHEDULABLE_TAINT_KEY,))
+                          extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
+                          device=device)
     encoder.vocabs.label_vals.intern("")
-    uk = jnp.int32(encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
-    ev = jnp.int32(encoder.vocabs.label_vals.get(""))
+    # the scalars are created ON the routed device — a jnp constructor on
+    # the default (possibly dead) backend is exactly what degraded mode
+    # must never touch
+    import contextlib
+
+    ctx = jax.default_device(device) if device is not None \
+        else contextlib.nullcontext()
+    with ctx:
+        uk = jnp.int32(encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
+        ev = jnp.int32(encoder.vocabs.label_vals.get(""))
     return snap, (uk, ev)
 
 

@@ -217,6 +217,7 @@ class Preemptor:
         handled: Set[str] = set()
         retry_soon: Set[str] = set()  # candidates whose space another lane
                                       # freed this burst: retry promptly
+        supervisor = getattr(scheduler, "supervisor", None)
         B = PREEMPT_BURST
         for lo in range(0, len(eligible), B):
             chunk = eligible[lo: lo + B]
@@ -226,22 +227,66 @@ class Preemptor:
             nnr_b = jnp.asarray(pend_nnr[rows], jnp.int32)
             prio_b = jnp.asarray(
                 np.array([p.priority for p, _, _ in pad], np.int32))
-            compiled = prewarmer.lookup_preempt(snap.dims, B) \
-                if prewarmer is not None else None
-            res: PreemptResult
-            if compiled is not None:
+
+            def _readback(res: PreemptResult):
+                return (np.asarray(jax.device_get(res.node)),
+                        np.asarray(jax.device_get(res.victims)),
+                        np.asarray(jax.device_get(res.n_pdb_violations)))
+
+            def _primary():
+                compiled = prewarmer.lookup_preempt(snap.dims, B) \
+                    if prewarmer is not None else None
+                if compiled is not None:
+                    try:
+                        return _readback(compiled(
+                            snap.tables, snap.existing, cls_b, nnr_b,
+                            prio_b, (uk, ev), pdb_dev, hw, ecfg))
+                    except TypeError:
+                        pass  # aval/pytree drift — ordinary jit path
+                return _readback(_preempt(
+                    snap.tables, snap.existing, cls_b, nnr_b, prio_b,
+                    snap.dims.D, (uk, ev), pdb_dev, hw, ecfg))
+
+            def _fallback(dev, hung=False):
+                # the same burst, re-dispatched on the CPU backend:
+                # committed inputs pin the execution there. A wedged
+                # primary's buffers are untouchable — and in degraded
+                # waves the snapshot is already fallback-resident (the
+                # scheduler routes fresh snapshots via snapshot_device()),
+                # so the only unreachable case is the backend dying
+                # BETWEEN this wave's cycle and its preemption pass:
+                # abort crash-consistently (nothing evicted), the pods
+                # requeue, and the next wave's snapshot is safe.
+                if hung:
+                    raise RuntimeError(
+                        "preempt fallback: primary buffers unreachable "
+                        "(hung backend)")
+                tb, ex, cb, nb, pb, ky, pd, hw_f, ec = jax.device_put(
+                    (snap.tables, snap.existing, cls_b, nnr_b, prio_b,
+                     (uk, ev), pdb_dev, hw, ecfg), dev)
+                with jax.default_device(dev):
+                    return _readback(_preempt(tb, ex, cb, nb, pb,
+                                              snap.dims.D, ky, pd, hw_f, ec))
+
+            if supervisor is not None:
+                from dataclasses import replace as _dc_replace
+
+                from .supervisor import DispatchAbandonedError
+
                 try:
-                    res = compiled(snap.tables, snap.existing, cls_b, nnr_b,
-                                   prio_b, (uk, ev), pdb_dev, hw, ecfg)
-                except TypeError:
-                    compiled = None
-            if compiled is None:
-                res = _preempt(snap.tables, snap.existing, cls_b, nnr_b,
-                               prio_b, snap.dims.D, (uk, ev), pdb_dev, hw,
-                               ecfg)
-            nodes_b = np.asarray(jax.device_get(res.node))
-            victims_b = np.asarray(jax.device_get(res.victims))
-            npdb_b = np.asarray(jax.device_get(res.n_pdb_violations))
+                    nodes_b, victims_b, npdb_b = supervisor.run(
+                        "preempt",
+                        (_dc_replace(snap.dims, has_node_name=False, P=1), B),
+                        _primary, _fallback)
+                except DispatchAbandonedError:
+                    # both backends refused the burst: NOTHING in this chunk
+                    # (or the remaining ones) was evaluated, so nothing is
+                    # evicted — every un-handled pod takes the ordinary
+                    # unschedulable/requeue path upstream. Crash-consistent:
+                    # evictions only ever happen after a successful readback.
+                    break
+            else:
+                nodes_b, victims_b, npdb_b = _primary()
 
             for lane, (pod, attempts, _row) in enumerate(chunk):
                 node_idx = int(nodes_b[lane])

@@ -161,6 +161,15 @@ class BucketPrewarmer:
         # (dims, engine, extras, gang) → jax Compiled for the cycle program;
         # ("preempt", dims, burst) → Compiled for the preemption burst
         self.compiled: dict = {}
+        # bumped by invalidate(): a background compile that STARTED before a
+        # backend loss must not register its executable afterward — it may
+        # be bound to the dead runtime, and calling it would re-poison the
+        # freshly recovered backend (recovery flap)
+        self._epoch = 0
+        # dispatch supervisor (sched/supervisor.py): background compile
+        # failures that look like backend loss are reported so the health
+        # machinery reacts to them exactly as to a failed live dispatch
+        self.supervisor = None
 
     def observe(self, d: Dims, n_nodes: int, n_existing: int,
                 engine: str = "waves", extras: tuple = (),
@@ -196,16 +205,24 @@ class BucketPrewarmer:
                     target=self._compile_fn,
                     args=(target, engine, extras, gang),
                     name=f"ktpu-prewarm-{target.N}x{target.E}", daemon=True)
-                self._inflight = t
+                # start BEFORE publishing: wait() joins _inflight without
+                # the lock, and joining a not-yet-started thread raises
                 t.start()
+                self._inflight = t
             return
 
     def _compile(self, d: Dims, engine: str, extras: tuple,
                  gang: bool) -> None:
         key = (replace(d, has_node_name=False), engine, extras, gang)
+        epoch = self._epoch
         try:
+            from ..utils import faultline
+            from ..utils.faultline import InjectedDeviceError
             from .cycle import _schedule_batch_impl
 
+            if faultline.should("device.error", "prewarm"):
+                raise InjectedDeviceError(
+                    "injected XlaRuntimeError at prewarm")
             (tables, pending, keys, existing, hw, ecfg,
              gang_args) = abstract_cycle_args(d, gang=gang)
             compiled = _schedule_batch_impl.lower(
@@ -213,20 +230,76 @@ class BucketPrewarmer:
                 extras, tuple(1.0 for _ in extras), gang_args,
             ).compile()
             with self._mu:
+                if epoch != self._epoch:
+                    # invalidate() ran mid-compile (backend loss): this
+                    # executable may be bound to the dead runtime — drop it
+                    # and let a post-recovery warm redo the work
+                    self._warmed.discard(key)
+                    return
                 self.compiled[key] = compiled
             self.warm_log.append((d, engine))
-        except Exception:
+        except Exception as e:
             # prewarming is an optimization: a failed background compile
             # must never take down the scheduling loop; the live path will
-            # compile on demand exactly as without a prewarmer
+            # compile on demand exactly as without a prewarmer. A failure
+            # that smells like backend loss IS reported to the supervisor.
             with self._mu:
                 self._warmed.discard(key)
+            if self.supervisor is not None:
+                self.supervisor.note_compile_failure(e)
 
     def lookup(self, d: Dims, engine: str, extras: tuple, gang: bool):
         """The stored Compiled for this cycle signature, or None. Called on
         the dispatch hot path — one dict probe."""
         return self.compiled.get(
             (replace(d, has_node_name=False), engine, extras, gang))
+
+    def invalidate(self) -> None:
+        """Drop every stored executable and warm record, and fence out
+        in-flight compiles (epoch bump: one that started before the loss
+        must not register afterward). Called on backend loss
+        (sched/supervisor.py): a Compiled bound to a dead runtime would
+        raise mid-wave exactly when the system is trying to degrade."""
+        with self._mu:
+            self._epoch += 1
+            self.compiled.clear()
+            self._warmed.clear()
+
+    def rewarm(self, d: Dims, engine: str = "waves", extras: tuple = (),
+               gang: bool = False) -> bool:
+        """Force a background compile of the CURRENT dims regardless of
+        occupancy thresholds — the backend re-admission path: the recovered
+        device's first wave should deserialize a warm executable, not pay a
+        cold compile on the hot path. If a compile is already in flight the
+        rewarm CHAINS behind it (one compile at a time still holds) rather
+        than being dropped. Returns True when the compile ran or was
+        scheduled."""
+        if not self.enabled:
+            return False
+        if max(d.N, d.E) < self.min_axis:
+            return False  # small shapes recompile in seconds on demand
+        key = (replace(d, has_node_name=False), engine, extras, gang)
+        with self._mu:
+            self._warmed.add(key)
+            prev = self._inflight
+            if prev is not None and prev.is_alive():
+                def chained():
+                    prev.join()
+                    self._compile_fn(d, engine, extras, gang)
+
+                t = threading.Thread(
+                    target=chained,
+                    name=f"ktpu-rewarm-{d.N}x{d.E}", daemon=True)
+            else:
+                t = threading.Thread(
+                    target=self._compile_fn, args=(d, engine, extras, gang),
+                    name=f"ktpu-rewarm-{d.N}x{d.E}", daemon=True)
+            # start BEFORE publishing (wait() joins without the lock; a
+            # not-yet-started thread would raise there). rewarm runs on the
+            # PROBER thread, so this race is cross-thread and real.
+            t.start()
+            self._inflight = t
+        return True
 
     # ---- preemption-burst program (sched/preemption.py _preempt) ---- #
 
@@ -262,31 +335,47 @@ class BucketPrewarmer:
             t = threading.Thread(
                 target=self._compile_preempt, args=(d, burst),
                 name=f"ktpu-prewarm-preempt-{d.N}x{d.E}", daemon=True)
+            t.start()  # before publishing: see observe()
             self._inflight_preempt = t
-            t.start()
 
     def _compile_preempt(self, d: Dims, burst: int) -> None:
         key = self._preempt_key(d, burst)
+        epoch = self._epoch
         try:
+            from ..utils import faultline
+            from ..utils.faultline import InjectedDeviceError
             from .preemption import _preempt
 
+            if faultline.should("device.error", "prewarm"):
+                raise InjectedDeviceError(
+                    "injected XlaRuntimeError at prewarm")
             (tables, existing, cls, nnr, prio, keys, pdb,
              hw, ecfg) = abstract_preempt_args(d, burst)
             compiled = _preempt.lower(
                 tables, existing, cls, nnr, prio, d.D, keys, pdb, hw, ecfg,
             ).compile()
             with self._mu:
+                if epoch != self._epoch:
+                    self._warmed.discard(key)  # invalidated mid-compile
+                    return
                 self.compiled[key] = compiled
             self.warm_log.append((d, "preempt"))
-        except Exception:
+        except Exception as e:
+            # same contract as _compile: never takes down the loop, but a
+            # device-class failure is a backend-loss signal the supervisor
+            # must hear
             with self._mu:
                 self._warmed.discard(key)
+            if self.supervisor is not None:
+                self.supervisor.note_compile_failure(e)
 
     def lookup_preempt(self, d: Dims, burst: int):
         return self.compiled.get(self._preempt_key(d, burst))
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Test/shutdown helper: join the in-flight compiles."""
-        for t in (self._inflight, self._inflight_preempt):
+        with self._mu:
+            threads = (self._inflight, self._inflight_preempt)
+        for t in threads:
             if t is not None:
                 t.join(timeout)

@@ -65,6 +65,9 @@ class CycleStats:
     scheduled: int = 0
     unschedulable: int = 0
     bind_errors: int = 0
+    # pods whose wave dispatch was abandoned (primary AND fallback failed):
+    # requeued promptly with attempts preserved — not failures of the pods
+    aborted: int = 0
     cycle_seconds: float = 0.0
     assignments: Dict[str, str] = field(default_factory=dict)
     # pod keys that failed this wave (feeds FailedScheduling events)
@@ -142,6 +145,14 @@ class Scheduler:
         from .prewarm import BucketPrewarmer
 
         self.prewarmer = BucketPrewarmer()
+        # every XLA call (wave dispatch, preemption burst, extender scores,
+        # background compiles) runs under the dispatch supervisor: deadline
+        # watchdog, CPU degradation on backend loss, prober re-admission
+        # (sched/supervisor.py)
+        from .supervisor import DispatchSupervisor
+
+        self.supervisor = DispatchSupervisor(prewarmer=self.prewarmer)
+        self.prewarmer.supervisor = self.supervisor
 
     # ------------------------------------------------------------------ #
     # event handlers (eventhandlers.go)
@@ -210,8 +221,12 @@ class Scheduler:
     def _snapshot_keys(self, pending: List[Pod]):
         from .cycle import snapshot_with_keys
 
+        # degraded mode routes the snapshot (and the interned-key scalars)
+        # onto the CPU fallback device: host staging is the ground truth,
+        # so nothing on this path touches the lost backend's buffers
         return snapshot_with_keys(self.cache, self.encoder, pending,
-                                  self.base_dims)
+                                  self.base_dims,
+                                  device=self.supervisor.snapshot_device())
 
     def schedule_pending(self, now: Optional[float] = None) -> CycleStats:
         """One wave: pump → pop batch → snapshot → device cycle → commit.
@@ -248,28 +263,92 @@ class Scheduler:
         pending = [p for p, _ in batch]
         snap, keys = self._snapshot_keys(pending)
         extras = tuple(p for p, _ in self._extra_score)
+        extra_w = tuple(w for _, w in self._extra_score)
+        from dataclasses import replace as _dc_replace
+
         from .cycle import _engine
 
+        wave_engine = "scan" if snap.dims.has_node_name else _engine()
+        gang_arg = snap.gang if self._device_gangs else None
         self.prewarmer.observe(
             snap.dims, n_nodes=self.cache.node_count,
             n_existing=self.cache.pod_count,
-            engine="scan" if snap.dims.has_node_name else _engine(),
+            engine=wave_engine,
             extras=extras,
             gang=self._device_gangs and snap.gang is not None)
-        res = _schedule_batch(snap.tables, snap.pending, keys, snap.dims.D,
-                              snap.existing,
-                              has_node_name=snap.dims.has_node_name,
-                              hard_weight=self.hard_pod_affinity_weight,
-                              ecfg=self.engine_config,
-                              extra_plugins=extras,
-                              extra_weights=tuple(w for _, w in self._extra_score),
-                              gang=snap.gang if self._device_gangs else None,
-                              dims=snap.dims, prewarmer=self.prewarmer)
-        # ---- double-buffered host/device overlap: the dispatch above is
-        # asynchronous, so while the device evaluates THIS wave, the host
-        # interns the NEXT wave's backlog (the dominant host cost of the
-        # next snapshot). By the time device_get blocks, cycle N+1's pod
-        # rows are already memoized — encode of N+1 overlapped dispatch of N.
+        self.supervisor.note_cycle_signature(
+            snap.dims, wave_engine, extras, gang_arg is not None)
+
+        def _primary():
+            res = _schedule_batch(
+                snap.tables, snap.pending, keys, snap.dims.D, snap.existing,
+                has_node_name=snap.dims.has_node_name,
+                hard_weight=self.hard_pod_affinity_weight,
+                ecfg=self.engine_config,
+                extra_plugins=extras, extra_weights=extra_w,
+                gang=gang_arg, dims=snap.dims, prewarmer=self.prewarmer)
+            return jax.device_get(res.node)
+
+        # the commit loop must map node indices through the node_order of
+        # the snapshot that was ACTUALLY dispatched: a fallback re-encode
+        # reflects newer cluster state (an informer event may have landed
+        # between the two snapshots), and indexing the old order would
+        # silently bind pods to the wrong nodes
+        wave_ctx = {"node_order": snap.node_order}
+
+        def _fallback(dev, hung=False):
+            # degrade to the CPU backend. Preferred: ship the SAME encoded
+            # wave (device_put of the primary-resident arrays — the cheap
+            # direction when they are still reachable, e.g. an injected
+            # fault or a computation-only failure). A wedged runtime's
+            # buffers are untouchable (hung=True: a transfer would block
+            # forever with no watchdog) and a dead one's raise — in both
+            # cases the wave RE-ENCODES onto the fallback from the cache's
+            # host staging, the ground truth the device arrays derive
+            # from. No prewarmer — its executables belong to the primary.
+            tb = None
+            dd = snap.dims
+            if not hung:
+                try:
+                    tb, pe, ex, ky, gg = jax.device_put(
+                        (snap.tables, snap.pending, snap.existing, keys,
+                         gang_arg), dev)
+                except Exception:  # noqa: BLE001 - dead-source transfer
+                    tb = None
+            if tb is None:
+                # supervisor already marked unhealthy → snapshot_device()
+                # is the fallback device: full host re-encode onto it
+                fsnap, fkeys = self._snapshot_keys(pending)
+                tb, pe, ex, ky, dd = (fsnap.tables, fsnap.pending,
+                                      fsnap.existing, fkeys, fsnap.dims)
+                gg = fsnap.gang if self._device_gangs else None
+                wave_ctx["node_order"] = fsnap.node_order
+            with jax.default_device(dev):
+                res = _schedule_batch(
+                    tb, pe, ky, dd.D, ex,
+                    has_node_name=dd.has_node_name,
+                    hard_weight=self.hard_pod_affinity_weight,
+                    ecfg=self.engine_config,
+                    extra_plugins=extras, extra_weights=extra_w,
+                    gang=gg)
+                return jax.device_get(res.node)
+
+        # the budget key carries the PROGRAM signature, not just the shape:
+        # a gang-bearing or scan-routed wave at a warm shape traces a new
+        # XLA program whose cold compile must get the cold budget — keying
+        # on dims alone would misread that compile as a hang and falsely
+        # mark a healthy backend lost
+        handle = self.supervisor.submit(
+            "cycle",
+            (_dc_replace(snap.dims, has_node_name=False), wave_engine,
+             extras, gang_arg is not None),
+            _primary, _fallback)
+        # ---- double-buffered host/device overlap: the dispatch above runs
+        # on the watchdog worker, so while the device evaluates THIS wave,
+        # the host interns the NEXT wave's backlog (the dominant host cost
+        # of the next snapshot). By the time handle.result() blocks, cycle
+        # N+1's pod rows are already memoized — encode of N+1 overlapped
+        # dispatch of N.
         if self.preemptor is not None:
             from .preemption import PREEMPT_BURST
 
@@ -279,9 +358,28 @@ class Scheduler:
         backlog = self.queue.peek_active(self.batch_size)
         if backlog:
             self.encoder.intern_pods(backlog)
-        node_idx = jax.device_get(res.node)
+        from .supervisor import DispatchAbandonedError
+
+        try:
+            node_idx = handle.result()
+        except DispatchAbandonedError:
+            # crash-consistent wave abort: the dispatch died on BOTH
+            # backends before any readback, so nothing was assumed and
+            # nothing may be committed — forget the wave cleanly and
+            # requeue every popped pod (attempts preserved, prompt retry:
+            # the pods are fine, the backend wasn't). Without this, a
+            # dispatch death mid-wave would silently LOSE the whole batch.
+            for pod, attempts in batch:
+                stats.aborted += 1
+                self.queue.add_prompt_retry(pod, attempts=attempts, now=now)
+            for pod, attempts in ext_batch:
+                stats.aborted += 1
+                self.queue.add_prompt_retry(pod, attempts=attempts, now=now)
+            stats.cycle_seconds = time.perf_counter() - t0
+            return stats
 
         failures: List[Tuple[Pod, int]] = []
+        wave_order = wave_ctx["node_order"]  # set by a fallback re-encode
         for i, (pod, attempts) in enumerate(batch):
             ni = int(node_idx[i])
             if ni < 0:
@@ -292,7 +390,7 @@ class Scheduler:
                 # already assumed/bound (e.g. an update raced the informer
                 # confirmation) — do not double-assume
                 continue
-            node_name = snap.node_order[ni]
+            node_name = wave_order[ni]
             self._commit(pod, node_name, attempts, now, cycle, stats)
 
         # ---- preemption pass: AFTER commits, against ONE fresh snapshot so
@@ -312,6 +410,7 @@ class Scheduler:
                 fresh = self.cache.snapshot(
                     self.encoder, [p for p, _ in failures], self.base_dims,
                     extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
+                    device=self.supervisor.snapshot_device(),
                 )
                 handled_keys = self.preemptor.preempt_burst(
                     self, eligible, fresh, now)
@@ -344,19 +443,63 @@ class Scheduler:
         snap, keys = self._snapshot_keys([pod])
         # one dispatch: infeasible nodes are -inf in the score matrix; the
         # extender path must see the SAME composed scores as the fused path
-        from ..ops.lattice import default_engine_config
+        from dataclasses import replace as _dc_replace
 
-        raw = jax.device_get(_scores(
-            snap.tables, snap.pending, keys, snap.dims.D, snap.existing,
-            jnp.float32(self.hard_pod_affinity_weight),
-            self.engine_config or default_engine_config(),
-            tuple(p for p, _ in self._extra_score),
-            tuple(w for _, w in self._extra_score)))[0]
+        from ..ops.lattice import default_engine_config
+        from .supervisor import DispatchAbandonedError
+
+        extras = tuple(p for p, _ in self._extra_score)
+        extra_w = tuple(w for _, w in self._extra_score)
+        # the feasible/score iteration below must walk the node_order (and
+        # use the D) of the snapshot that actually dispatched — a fallback
+        # re-encode reflects newer cluster state (see the wave path)
+        score_ctx = {"node_order": snap.node_order, "D": snap.dims.D}
+
+        def _score_on(args, D):
+            tb, pe, ky, ex = args
+            return jax.device_get(_scores(
+                tb, pe, ky, D, ex,
+                jnp.float32(self.hard_pod_affinity_weight),
+                self.engine_config or default_engine_config(),
+                extras, extra_w))[0]
+
+        def _score_fallback(dev, hung=False):
+            args = None
+            if not hung:
+                try:
+                    args = jax.device_put(
+                        (snap.tables, snap.pending, keys, snap.existing),
+                        dev)
+                except Exception:  # noqa: BLE001 - dead-source transfer
+                    args = None
+            if args is None:
+                # host re-encode onto the fallback (same ladder as the
+                # wave path; supervisor is unhealthy here)
+                fsnap, fkeys = self._snapshot_keys([pod])
+                args = (fsnap.tables, fsnap.pending, fkeys, fsnap.existing)
+                score_ctx["node_order"] = fsnap.node_order
+                score_ctx["D"] = fsnap.dims.D
+            with jax.default_device(dev):
+                return _score_on(args, score_ctx["D"])
+
+        try:
+            raw = self.supervisor.run(
+                "scores",
+                (_dc_replace(snap.dims, has_node_name=False), extras),
+                lambda: _score_on((snap.tables, snap.pending, keys,
+                                   snap.existing), snap.dims.D),
+                _score_fallback)
+        except DispatchAbandonedError:
+            # same crash-consistency contract as the wave path: nothing was
+            # assumed — requeue promptly instead of losing the pod
+            stats.aborted += 1
+            self.queue.add_prompt_retry(pod, attempts=attempts, now=now)
+            return
 
         nodes_by_name = {n.name: n for n in self.cache.nodes()}
         feasible: List[str] = []
         combined: Dict[str, float] = {}
-        for i, name in enumerate(snap.node_order):
+        for i, name in enumerate(score_ctx["node_order"]):
             if raw[i] != float("-inf"):
                 feasible.append(name)
                 combined[name] = float(raw[i])
@@ -389,6 +532,7 @@ class Scheduler:
                 fresh = self.cache.snapshot(
                     self.encoder, [pod], self.base_dims,
                     extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
+                    device=self.supervisor.snapshot_device(),
                 )
                 handled = self.preemptor.try_preempt(self, pod, attempts, fresh, now)
             if not handled:
@@ -565,6 +709,7 @@ class Scheduler:
             total.scheduled += s.scheduled
             total.unschedulable += s.unschedulable
             total.bind_errors += s.bind_errors
+            total.aborted += s.aborted
             total.assignments.update(s.assignments)
             if self.queue.lengths()[0] == 0:
                 break

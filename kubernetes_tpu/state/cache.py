@@ -99,6 +99,10 @@ class Snapshot:
                                          # maps victim rows back to pod keys)
     gang: object = None  # GangArrays (ops/gang.py) when any pending pod is
                          # gang-grouped; None routes the plain engines
+    device: object = None  # explicit placement of the device arrays (None =
+                           # default). The dispatch supervisor routes
+                           # degraded-mode snapshots to the CPU fallback so
+                           # no cycle ever touches a lost backend's buffers.
 
 
 class SchedulerCache:
@@ -348,6 +352,7 @@ class SchedulerCache:
         pending: Sequence[Pod],
         base_dims: Optional[Dims] = None,
         extra_intern: Sequence[str] = (),
+        device: object = None,
     ) -> Snapshot:
         """UpdateNodeInfoSnapshot analog (cache.go:204-255): return the cached
         encoded view when nothing changed; re-encode ONLY the dirty node/pod
@@ -364,7 +369,8 @@ class SchedulerCache:
             gen = self._generation
             snap = self._snapshot
             if snap is not None and snap.generation == gen \
-                    and snap.pending_keys == pending_keys:
+                    and snap.pending_keys == pending_keys \
+                    and snap.device == device:
                 self.last_snapshot_mode = "cached"
                 return snap
 
@@ -475,14 +481,21 @@ class SchedulerCache:
                 or self._staging_nodes is None
                 or self._encoder is not encoder
                 or projection_widened
+                # placement change (degradation onto the CPU fallback, or
+                # recovery back to the primary): the resident arrays live
+                # on the WRONG — possibly dead — device, so the patch
+                # path's scatter-into-resident is unusable; rebuild from
+                # the host staging, which never left the host
+                or snap.device != device
                 or replace(d, has_node_name=False)
                 != replace(snap.dims, has_node_name=False)
             )
             if full:
                 return self._full_snapshot(encoder, pending, pending_keys,
-                                           gen, d, base_dims)
+                                           gen, d, base_dims, device)
             return self._patch_snapshot(encoder, pending, pending_keys,
-                                        gen, d, snap, released_nodes)
+                                        gen, d, snap, released_nodes,
+                                        device)
 
     @staticmethod
     def _registry_sizes(encoder: Encoder) -> Dict[str, int]:
@@ -517,7 +530,8 @@ class SchedulerCache:
         )
 
     def _full_snapshot(self, encoder, pending, pending_keys, gen, d,
-                       base_dims: Optional[Dims] = None) -> Snapshot:
+                       base_dims: Optional[Dims] = None,
+                       device: object = None) -> Snapshot:
         """Cold path: rebuild staging + every device table. Runs when
         capacities grow (recompile territory anyway) or on first use."""
         self.last_snapshot_mode = "full"
@@ -589,13 +603,14 @@ class SchedulerCache:
         snap = Snapshot(
             generation=gen,
             node_order=list(self._node_names),
-            tables=jax.device_put(tables),
-            existing=jax.device_put(self._existing_pod_arrays(d)),
-            pending=jax.device_put(pe),
+            tables=jax.device_put(tables, device),
+            existing=jax.device_put(self._existing_pod_arrays(d), device),
+            pending=jax.device_put(pe, device),
             dims=d,
             pending_keys=pending_keys,
             existing_keys=tuple(self._pod_keys),
             gang=self._gang_arrays(encoder, pending, d),
+            device=device,
         )
         self._encoder = encoder
         self._reg_sizes = self._registry_sizes(encoder)
@@ -613,7 +628,8 @@ class SchedulerCache:
 
     def _patch_snapshot(self, encoder, pending, pending_keys, gen, d,
                         snap: Snapshot,
-                        released_nodes: Sequence[int] = ()) -> Snapshot:
+                        released_nodes: Sequence[int] = (),
+                        device: object = None) -> Snapshot:
         """Steady-state path: O(changed) host work, O(changed) device scatter.
         This is what makes `state/encode.py`'s "patched incrementally" promise
         true — no full re-encode, no full re-upload."""
@@ -665,17 +681,23 @@ class SchedulerCache:
             tables = tables._replace(
                 nodes=tables.nodes._replace(
                     topo=jax.device_put(
-                        np.ascontiguousarray(self._staging_nodes.topo)),
+                        np.ascontiguousarray(self._staging_nodes.topo),
+                        device),
                     domain=jax.device_put(
-                        np.ascontiguousarray(self._staging_nodes.domain))),
-                zone_keys=jax.device_put(encoder.build_zone_keys()))
+                        np.ascontiguousarray(self._staging_nodes.domain),
+                        device)),
+                zone_keys=jax.device_put(encoder.build_zone_keys(), device))
         if node_idx:
             kb = bucket(len(node_idx))
             idx = _pad_patch(node_idx, kb)
             rows = NodeArrays(*[np.ascontiguousarray(f[idx])
                                 for f in self._staging_nodes])
+            # indices ride device_put WITH the snapshot's placement: a bare
+            # jnp.asarray would materialize on the default (possibly lost)
+            # backend even when the rest of the patch targets the fallback
             tables = tables._replace(
-                nodes=_patch_rows(tables.nodes, jnp.asarray(idx), rows))
+                nodes=_patch_rows(tables.nodes,
+                                  jax.device_put(idx, device), rows))
 
         # --- small interned tables: rebuild only the ones whose registry grew
         sizes = self._registry_sizes(encoder)
@@ -692,7 +714,7 @@ class SchedulerCache:
                 "volsets": encoder.build_volset_table,
             }
             tables = tables._replace(**{
-                k: jax.device_put(builders[k](d))
+                k: jax.device_put(builders[k](d), device)
                 for k in builders if sizes[k] != self._reg_sizes[k]
             })
             self._reg_sizes = sizes
@@ -737,7 +759,8 @@ class SchedulerCache:
             idx = _pad_patch(pod_idx, kb)
             host = self._existing_pod_arrays(d)
             rows = PodArrays(*[np.ascontiguousarray(f[idx]) for f in host])
-            existing = _patch_rows(existing, jnp.asarray(idx), rows)
+            existing = _patch_rows(existing, jax.device_put(idx, device),
+                                   rows)
 
         # --- pending: identity-diffed against the previous batch ---
         # The unschedulable/backoff queues feed largely the SAME pod
@@ -750,7 +773,7 @@ class SchedulerCache:
             pe = snap.pending
         else:
             pe = self._pending_block(encoder, pending, pending_keys, d,
-                                     snap.pending)
+                                     snap.pending, device)
 
         new_snap = Snapshot(
             generation=gen,
@@ -762,6 +785,7 @@ class SchedulerCache:
             pending_keys=pending_keys,
             existing_keys=tuple(self._pod_keys),
             gang=self._gang_arrays(encoder, pending, d),
+            device=device,
         )
         self._dirty_nodes.clear()
         self._dirty_pods.clear()
@@ -771,7 +795,7 @@ class SchedulerCache:
 
 
     def _pending_block(self, encoder, pending, pending_keys, d: Dims,
-                       prev_device):
+                       prev_device, device: object = None):
         """Pending PodArrays, identity-diffed against the previous batch:
         when the batch largely repeats, only the changed slots re-derive on
         the persistent host stage and SCATTER into the resident device
@@ -813,12 +837,13 @@ class SchedulerCache:
                     node_id=stage.node_id[idx],
                     node_name_req=np.ascontiguousarray(stage.rows[idx, 5]),
                 )
-                return _patch_rows(prev_device, jnp.asarray(idx), rows)
+                return _patch_rows(prev_device,
+                                   jax.device_put(idx, device), rows)
         pe_host = encoder.build_pod_arrays(
             list(pending), d, self._node_slot, capacity=d.P)
         self._pending_stage = _PendingStage.from_pod_arrays(pe_host)
         self._pending_stage_keys = pending_keys
-        return jax.device_put(pe_host)
+        return jax.device_put(pe_host, device)
 
 
 class _PendingStage:

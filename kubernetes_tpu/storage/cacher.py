@@ -55,6 +55,16 @@ class WatchCache:
                 self._horizon = evicted.rev
             self._ring.append(ev)
 
+    def compact(self, at_rev: int) -> None:
+        """Drop every retained event at or below `at_rev` and raise the
+        horizon to it — what a sustained storm does to the ring organically
+        (old revisions churn out). Resumes below the new horizon fall back
+        to storage, where a compacted revision earns its 410."""
+        with self._mu:
+            while self._ring and self._ring[0].rev <= at_rev:
+                self._ring.popleft()
+            self._horizon = max(self._horizon, at_rev)
+
     def events_since(self, since: int, prefix: str) -> Optional[List[CachedEvent]]:
         """Events with rev > since under prefix, from memory — or None when
         `since` predates the ring's horizon (caller falls back to storage)."""

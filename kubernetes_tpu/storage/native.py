@@ -330,6 +330,12 @@ class PyKV:
 
 def new_kv(prefer_native: bool = True):
     """Factory: native store if buildable, else the Python replica."""
+    from kubernetes_tpu.utils import faultline
+
+    if faultline.should("native.dlopen", "new_kv"):
+        # chaos: the .so linked against a newer libc than this host —
+        # dlopen fails, the PyKV fallback must carry the store
+        return PyKV()
     if prefer_native:
         try:
             return NativeKV()

@@ -22,6 +22,7 @@ from kubernetes_tpu.machinery import errors, meta
 from kubernetes_tpu.machinery import watch as mwatch
 from kubernetes_tpu.storage import native
 from kubernetes_tpu.storage.cacher import CachedEvent, WatchCache
+from kubernetes_tpu.utils import faultline
 
 Obj = Dict[str, Any]
 Predicate = Optional[Callable[[Obj], bool]]
@@ -75,6 +76,19 @@ class Storage:
                 w.stop()
             self._watchers.clear()
         self.kv.close()
+
+    def drop_watchers(self) -> int:
+        """Terminate every registered watch stream (the data survives).
+        This is what an apiserver restart looks like from a client: the
+        store (etcd) keeps its state, every open watch connection dies, and
+        reflectors must re-establish/relist. Used by the chaos injector's
+        ``apiserver.restart`` seam; returns the number of streams dropped."""
+        with self._watch_mu:
+            n = len(self._watchers)
+            for _, w, _, _, _ in self._watchers:
+                w.stop()
+            self._watchers.clear()
+        return n
 
     # ------------------------------------------------------------------ #
     # CRUD (etcd3 store.go Create:143 / Get:86 / Delete / GuaranteedUpdate:219)
@@ -132,6 +146,8 @@ class Storage:
         the new object, or raises to abort.
         """
         first = True
+        chaos_cas = False  # at most one injected conflict per call: the
+        # retry loop must converge even under FAULT_SPEC=store.cas_conflict@1.0
         while True:
             rec = self.kv.get(key)
             if rec is None:
@@ -150,6 +166,12 @@ class Storage:
                     "to the latest version and try again")
             first = False
             updated = update_fn(meta.deep_copy(cur))
+            if not chaos_cas and faultline.should("store.cas_conflict",
+                                                  "guaranteed_update"):
+                # chaos: behave exactly as if a concurrent writer won the
+                # CAS race — skip the put and take the re-read/retry path
+                chaos_cas = True
+                continue
             rev = self.kv.txn_put(key, cur_mod if cur_mod else 0, _encode(updated))
             if rev > 0:
                 out = meta.deep_copy(updated)
@@ -169,6 +191,15 @@ class Storage:
         since_rv ""/"0" = from now. Raises Gone(410) if since_rv predates
         compaction — the caller must relist (reflector relist semantics).
         """
+        if faultline.should("store.compact", "watch"):
+            # chaos: a REAL compaction at the current revision — stale
+            # resumes below earn a genuine 410, and the dispatch pump's own
+            # compaction handling runs against true state, not a mock. The
+            # cacher ring compacts with it (a sustained storm churns old
+            # revisions out of the window organically).
+            at = self.kv.rev()
+            self.kv.compact(at)
+            self.watch_cache.compact(at)
         w = mwatch.Watch(capacity=8192)
         with self._watch_mu:
             # "" / "0" = from NOW: the current store revision, regardless of

@@ -1,0 +1,552 @@
+"""End-to-end invariants under chaos fault injection (utils/faultline.py).
+
+Every test drives a REAL subsystem — scheduler + supervisor, storage, the
+informer reflector, the apiserver — through an injected fault and asserts the
+operational invariants docs/RESILIENCE.md promises:
+
+  * no pod lost (every popped pod is bound or requeued — never dropped)
+  * no pod double-bound (the Binding ledger has no duplicate keys)
+  * the cache/queue/binder ledgers converge after the fault clears
+  * cycle latency stays bounded during degradation
+  * the TPU^W primary backend is re-admitted cleanly after recovery
+
+All seeds are fixed and every fault is hit-count- or seeded-probability-gated,
+so the suite is deterministic; it runs in tier-1 under the `chaos` marker.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import Node, Pod, Resources
+from kubernetes_tpu.sched.preemption import Preemptor
+from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+from kubernetes_tpu.state.dims import Dims
+from kubernetes_tpu.utils import faultline
+
+pytestmark = pytest.mark.chaos
+
+HOSTNAME = "kubernetes.io/hostname"
+
+
+@pytest.fixture(autouse=True)
+def _fast_watchdog(monkeypatch):
+    """Tight, test-friendly supervisor knobs: warm dispatches get a 0.75 s
+    deadline (cold calls still get the full compile budget), the prober
+    retries every 50 ms. Uninstalls any fault line on teardown."""
+    monkeypatch.setenv("KTPU_DISPATCH_DEADLINE", "0.75")
+    monkeypatch.setenv("KTPU_PROBE_BACKOFF", "0.05")
+    yield
+    faultline.uninstall()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def mknode(name, cpu=4, mem="8Gi", **kw):
+    kw.setdefault("labels", {HOSTNAME: name})
+    return Node(name=name,
+                allocatable=Resources.make(cpu=cpu, memory=mem, pods=110),
+                **kw)
+
+
+def mkpod(name, cpu="100m", mem="64Mi", **kw):
+    return Pod(name=name, requests=Resources.make(cpu=cpu, memory=mem), **kw)
+
+
+def mksched(binder=None, **kw):
+    # base_dims pins the capacity buckets so every wave shares ONE shape
+    # signature: wave 1 warms it (cold deadline), later waves run under the
+    # tight warm deadline the fault tests rely on
+    kw.setdefault("base_dims", Dims(N=16, P=16, E=64))
+    kw.setdefault("batch_size", 8)
+    return Scheduler(binder=binder or RecordingBinder(), **kw)
+
+
+def assert_ledger(s, binder, total_pods):
+    """The cross-system ledger: every pod bound exactly once, the cache
+    mirrors the binder, the queues are empty, and the snapshot generation
+    has converged (a fresh snapshot is served from cache)."""
+    keys = [k for k, _ in binder.bound]
+    assert len(keys) == total_pods, f"lost pods: {total_pods - len(keys)}"
+    assert len(set(keys)) == len(keys), "double-bound pods"
+    assert s.cache.counts()[1] == total_pods
+    assert s.queue.lengths() == (0, 0, 0)
+    snap1 = s.cache.snapshot(s.encoder, [], s.base_dims)
+    snap2 = s.cache.snapshot(s.encoder, [], s.base_dims)
+    assert snap2 is snap1 and s.cache.last_snapshot_mode == "cached"
+    assert snap1.generation == s.cache.generation
+
+
+# --------------------------------------------------------------------- #
+# device faults → supervisor degradation ladder
+# --------------------------------------------------------------------- #
+
+
+def test_device_hang_degrades_to_cpu_and_recovers():
+    """FAULT_SPEC=device.hang@cycle:2: wave 2's dispatch wedges. The
+    watchdog must abandon it within one deadline, complete the wave on the
+    CPU fallback with zero lost/double-bound pods, and the prober must
+    re-admit the primary backend."""
+    faultline.install("device.hang@cycle:2")
+    binder = RecordingBinder()
+    s = mksched(binder)
+    for i in range(8):
+        s.on_node_add(mknode(f"n{i}"))
+    for i in range(24):
+        s.on_pod_add(mkpod(f"p{i}"))
+
+    total = s.run_until_idle()
+
+    st = s.supervisor.stats
+    assert st.watchdog_timeouts == 1
+    assert st.degraded_cycles >= 1 and st.fallback_dispatches >= 1
+    assert total.scheduled == 24
+    assert_ledger(s, binder, 24)
+    # degradation happened within ~one watchdog deadline (0.75 s) plus the
+    # fallback dispatch — NOT a minutes-long probe-hang discovery. The
+    # fallback pays at most one cold CPU compile at this tiny shape, so
+    # p99 during degradation stays bounded.
+    assert max(st.degraded_cycle_seconds) < 30.0
+    assert s.supervisor.wait_recovered(15), "prober never re-admitted"
+    assert st.recoveries == 1 and st.last_recovery_s is not None
+    s.prewarmer.wait(timeout=60)  # join background compiles before teardown
+
+
+def test_device_error_falls_back_without_losing_the_wave():
+    """An XlaRuntimeError-class failure mid-dispatch (OOM, backend loss)
+    takes the same ladder as a hang, minus the deadline wait."""
+    faultline.install("device.error@cycle:2,device.oom@cycle:3")
+    binder = RecordingBinder()
+    s = mksched(binder)
+    for i in range(8):
+        s.on_node_add(mknode(f"n{i}"))
+    for i in range(32):
+        s.on_pod_add(mkpod(f"p{i}"))
+
+    total = s.run_until_idle()
+    st = s.supervisor.stats
+    assert st.device_errors >= 1
+    assert st.fallback_dispatches >= 1
+    assert total.scheduled == 32
+    assert_ledger(s, binder, 32)
+    assert s.supervisor.wait_recovered(15)
+    s.prewarmer.wait(timeout=60)
+
+
+def test_dispatch_abandoned_forgets_cleanly_and_requeues():
+    """Total loss: primary AND fallback fail for one wave. The wave must
+    abort crash-consistently — nothing assumed, nothing bound, every popped
+    pod requeued with attempts preserved — and the next wave places them."""
+    faultline.install("device.error@cycle:2,device.fallback@cycle:1")
+    binder = RecordingBinder()
+    s = mksched(binder)
+    for i in range(8):
+        s.on_node_add(mknode(f"n{i}"))
+    for i in range(8):
+        s.on_pod_add(mkpod(f"p{i}"))
+
+    ok = s.schedule_pending()           # wave 1: clean (warms the shape)
+    assert ok.scheduled == 8
+    for i in range(8, 16):
+        s.on_pod_add(mkpod(f"p{i}"))
+    aborted = s.schedule_pending()      # wave 2: both backends die
+    assert aborted.aborted == 8 and aborted.scheduled == 0
+    assert s.supervisor.stats.abandoned == 1
+    # crash consistency: no half-committed state anywhere
+    assert s.cache.counts()[2] == 8     # only wave 1's assumes remain
+    assert len(binder.bound) == 8
+    assert s.queue.lengths()[0] == 8    # the whole batch is back in activeQ
+
+    total = s.run_until_idle()          # fault exhausted → wave succeeds
+    assert total.scheduled >= 8
+    assert_ledger(s, binder, 16)
+    assert s.supervisor.wait_recovered(15)
+    s.prewarmer.wait(timeout=60)
+
+
+def test_preempt_burst_supervised_fallback():
+    """A device error inside the preemption burst degrades to the CPU
+    fallback and still evicts/nominates — the storm is not lost."""
+    faultline.install("device.error@preempt:1")
+    clock = FakeClock()
+    s = Scheduler(binder=RecordingBinder(), clock=clock,
+                  preemptor=Preemptor(),
+                  base_dims=Dims(N=16, P=16, E=64))
+    s.on_node_add(mknode("n0", cpu=1))
+    victim = mkpod("victim", cpu="800m")
+    victim.node_name = "n0"
+    s.on_pod_add(victim)
+    s.on_pod_add(Pod(name="vip", priority=100,
+                     requests=Resources.make(cpu="800m", memory="256Mi")))
+    st = s.schedule_pending()
+    assert st.scheduled == 0
+    assert s.preemptor.evictor.evicted == ["default/victim"]
+    assert s.queue.nominated_node("default/vip") == "n0"
+    assert s.supervisor.stats.fallback_dispatches >= 1
+    clock.t = 5.0
+    st2 = s.schedule_pending()
+    assert st2.assignments.get("default/vip") == "n0"
+    assert s.supervisor.wait_recovered(15)
+    s.prewarmer.wait(timeout=60)
+
+
+def test_backend_readmission_rewarm(monkeypatch):
+    """Recovery must re-warm the cycle executable in the background so the
+    first post-recovery wave never pays a cold compile on the hot path."""
+    faultline.install("device.error@cycle:2")
+    binder = RecordingBinder()
+    s = mksched(binder)
+    s.prewarmer.min_axis = 1  # let the tiny test shape rewarm
+    for i in range(4):
+        s.on_node_add(mknode(f"n{i}"))
+    for i in range(16):
+        s.on_pod_add(mkpod(f"p{i}"))
+    s.run_until_idle()
+    assert s.supervisor.wait_recovered(15)
+    s.prewarmer.wait(timeout=120)
+    assert s.supervisor.stats.rewarms == 1
+    # the re-admitted backend's signature is warm again
+    assert any(eng == "waves" for _, eng in s.prewarmer.warm_log)
+    assert_ledger(s, binder, 16)
+
+
+def test_snapshot_device_routing_rebuilds_on_placement_change():
+    """Degraded mode routes snapshots to the CPU fallback device: a
+    placement change must force a full host re-encode (the resident arrays
+    live on the wrong — possibly dead — device; host staging is the ground
+    truth), and the same placement must serve from cache again."""
+    import jax
+
+    s = mksched()
+    for i in range(4):
+        s.on_node_add(mknode(f"n{i}"))
+    cache, enc = s.cache, s.encoder
+    snap_a = cache.snapshot(enc, [], s.base_dims)
+    assert snap_a.device is None
+    cpu = jax.devices("cpu")[0]
+    snap_b = cache.snapshot(enc, [], s.base_dims, device=cpu)
+    assert snap_b is not snap_a
+    assert cache.last_snapshot_mode == "full"  # never a patch across devices
+    assert snap_b.device is cpu
+    assert cache.snapshot(enc, [], s.base_dims, device=cpu) is snap_b
+    # recovery: back to default placement → full re-encode again
+    snap_c = cache.snapshot(enc, [], s.base_dims)
+    assert snap_c is not snap_b and cache.last_snapshot_mode == "full"
+    assert snap_c.device is None
+
+
+def test_prewarm_invalidate_fences_inflight_compile(monkeypatch):
+    """A background compile that STARTED before a backend loss must not
+    register its executable after invalidate() — it may be bound to the
+    dead runtime, and serving it post-recovery would re-poison the backend
+    (recovery flap)."""
+    import kubernetes_tpu.sched.prewarm as pw
+
+    p = pw.BucketPrewarmer()
+    d = Dims()
+    real = pw.abstract_cycle_args
+
+    def invalidate_mid_compile(dd, gang=False):
+        p.invalidate()  # the backend dies while this compile is running
+        return real(dd, gang=gang)
+
+    monkeypatch.setattr(pw, "abstract_cycle_args", invalidate_mid_compile)
+    p._compile(d, "waves", (), False)
+    assert p.compiled == {}, "stale executable registered past invalidate()"
+    assert not p._warmed, "stale warm record survived the fence"
+    # a post-recovery rewarm redoes the work cleanly
+    monkeypatch.setattr(pw, "abstract_cycle_args", real)
+    p.min_axis = 1
+    assert p.rewarm(d)
+    p.wait(timeout=120)
+    assert len(p.compiled) == 1
+
+
+# --------------------------------------------------------------------- #
+# storage faults
+# --------------------------------------------------------------------- #
+
+
+def test_store_cas_conflict_converges():
+    """Injected CAS conflicts (a concurrent writer winning the race) must
+    only cost retries — every guaranteed_update lands exactly once."""
+    from kubernetes_tpu.storage.native import PyKV
+    from kubernetes_tpu.storage.store import Storage
+
+    fl = faultline.install("store.cas_conflict@0.5", seed=7)
+    st = Storage(kv=PyKV())
+    try:
+        st.create("/registry/configmaps/default/ctr",
+                  {"metadata": {"name": "ctr"}, "data": {"n": 0}})
+        for _ in range(40):
+            st.guaranteed_update(
+                "/registry/configmaps/default/ctr",
+                lambda o: {**o, "data": {"n": o["data"]["n"] + 1}})
+        out = st.get("/registry/configmaps/default/ctr")
+        assert out["data"]["n"] == 40
+        assert fl.fired("store.cas_conflict") > 0, "fault never exercised"
+    finally:
+        st.close()
+
+
+def test_store_compaction_410_forces_relist():
+    """An injected compaction storm: a watch resuming from a pre-compaction
+    revision earns a genuine 410 Gone; a fresh watch works."""
+    from kubernetes_tpu.machinery import errors
+    from kubernetes_tpu.storage.native import PyKV
+    from kubernetes_tpu.storage.store import Storage
+
+    st = Storage(kv=PyKV())
+    try:
+        for i in range(5):
+            st.create(f"/registry/pods/default/p{i}",
+                      {"metadata": {"name": f"p{i}"}})
+        faultline.install("store.compact@watch:1")
+        with pytest.raises(errors.StatusError) as ei:
+            st.watch("/registry/pods/", since_rv="1")
+        assert ei.value.code == 410
+        # wait out the pump's own compaction handling (it may observe the
+        # compaction mid-dispatch and reset its horizon once) so the fresh
+        # watch below cannot race an ERROR broadcast
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                st._dispatched_rev < st.kv.compacted_rev():
+            time.sleep(0.02)
+        # post-storm: watching from now is clean
+        w = st.watch("/registry/pods/")
+        st.create("/registry/pods/default/p9",
+                  {"metadata": {"name": "p9"}})
+        ev = w.next(timeout=5)
+        assert ev is not None and ev.object["metadata"]["name"] == "p9"
+        w.stop()
+    finally:
+        st.close()
+
+
+def test_native_dlopen_falls_back_to_pykv():
+    """A dlopen failure (GLIBC mismatch) must yield the PyKV replica, and
+    the Storage built on it must be fully functional."""
+    from kubernetes_tpu.storage import native
+    from kubernetes_tpu.storage.store import Storage
+
+    faultline.install("native.dlopen")
+    kv = native.new_kv()
+    assert isinstance(kv, native.PyKV)
+    st = Storage(kv=kv)
+    try:
+        st.create("/registry/pods/default/a", {"metadata": {"name": "a"}})
+        assert st.get("/registry/pods/default/a")["metadata"]["name"] == "a"
+    finally:
+        st.close()
+
+
+# --------------------------------------------------------------------- #
+# watch-stream faults → reflector resilience
+# --------------------------------------------------------------------- #
+
+
+def test_watch_storm_informer_converges():
+    """Stream drops and forced relists mid-storm: the reflector must
+    redeliver every event (drops lose the in-flight event WITH the stream,
+    so the resume from the un-advanced RV replays it) and converge to the
+    full object set with nothing lost."""
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import Client, SharedInformer
+
+    fl = faultline.install("watch.drop@0.3,watch.relist@0.1", seed=11)
+    api = APIServer()
+    client = Client.local(api)
+    inf = SharedInformer(client.pods, namespace="default",
+                         relist_backoff=0.02).start()
+    try:
+        assert inf.wait_for_sync(10)
+        for i in range(40):
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"st-{i}", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "i"}]}})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(inf.indexer) < 40:
+            time.sleep(0.05)
+        assert len(inf.indexer) == 40, f"lost events: {len(inf.indexer)}/40"
+        assert fl.fired("watch.drop") > 0, "storm never exercised"
+    finally:
+        inf.stop()
+        api.close()
+
+
+def test_relist_backoff_grows_and_caps():
+    """The reflector's relist cadence under a persistent failure: delays
+    double per round with jitter, clamped to the cap (machinery/wait.Backoff
+    semantics — a capped round sleeps exactly the cap)."""
+    from kubernetes_tpu.client.informers import RelistBackoff
+
+    b = RelistBackoff(base=0.5, cap=8.0)
+    for i in range(8):
+        d = b.next()
+        raw = 0.5 * 2 ** i
+        assert min(raw, 8.0) <= d <= min(raw * 1.5, 8.0)
+        if raw >= 8.0:
+            assert d == 8.0  # capped rounds sleep exactly the cap
+    assert b.attempts == 8
+    b.reset()
+    assert b.attempts == 0
+    assert 0.5 <= b.next() <= 0.75
+
+
+def test_apiserver_restart_between_requests():
+    """The apiserver dies and comes back between two requests: storage
+    survives, every open watch dies, the hit request fails 503. Clients
+    retry; informers re-establish and converge — no object lost."""
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import Client, SharedInformer
+    from kubernetes_tpu.machinery import errors
+
+    api = APIServer()
+    client = Client.local(api)
+    inf = SharedInformer(client.pods, namespace="default",
+                         relist_backoff=0.02).start()
+    try:
+        assert inf.wait_for_sync(10)
+        # the restart hits an upcoming request; creates retry through it
+        faultline.install("apiserver.restart@handle_rest:3")
+        made = 0
+        for i in range(10):
+            body = {"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"rs-{i}", "namespace": "default"},
+                    "spec": {"containers": [{"name": "c", "image": "i"}]}}
+            for attempt in (1, 2):
+                try:
+                    client.pods.create(body)
+                    made += 1
+                    break
+                except errors.StatusError as e:
+                    assert e.code == 503 and attempt == 1
+        assert made == 10
+        assert faultline.active().fired("apiserver.restart") == 1
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(inf.indexer) < 10:
+            time.sleep(0.05)
+        assert len(inf.indexer) == 10
+    finally:
+        inf.stop()
+        api.close()
+
+
+def test_leaderelection_releases_lease_on_graceful_stop():
+    """Graceful stop must zero the Lease via CAS so the next candidate
+    acquires immediately instead of waiting out lease_duration."""
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import (Client, LeaderElectionConfig,
+                                       LeaderElector)
+
+    api = APIServer()
+    client = Client.local(api)
+    try:
+        cfg = dict(lock_name="sched-chaos", lease_duration=30.0,
+                   renew_deadline=10.0, retry_period=0.1)
+        a = LeaderElector(client, LeaderElectionConfig(identity="a", **cfg))
+        a.start()
+        assert a.wait_for_leadership(5)
+        a.stop()
+        lease = client.leases.get("sched-chaos", "kube-system")
+        assert lease["spec"]["holderIdentity"] == ""
+        assert float(lease["spec"]["renewTime"] or 0) == 0.0
+        # with a 30 s lease_duration, immediate takeover proves the release
+        # (un-released, b would wait out the full duration)
+        b = LeaderElector(client, LeaderElectionConfig(identity="b", **cfg))
+        t0 = time.monotonic()
+        b.start()
+        assert b.wait_for_leadership(5)
+        assert time.monotonic() - t0 < 5.0
+        b.stop()
+    finally:
+        api.close()
+
+
+# --------------------------------------------------------------------- #
+# Permit-wait deadline (sched/scheduler.py expire_waiting)
+# --------------------------------------------------------------------- #
+
+
+def test_permit_wait_deadline_unreserves_forgets_requeues_exactly_once():
+    """A waiting pod past its Permit deadline is unreserved (plugin sees
+    the ORIGINAL unstamped pod), forgotten from the cache, and requeued —
+    each exactly once; a second expiry pass is a no-op."""
+    from kubernetes_tpu.framework.interface import (Code, PermitPlugin,
+                                                    Status, UnreservePlugin)
+    from kubernetes_tpu.framework.plugins import Plugins, PluginSet
+    from kubernetes_tpu.framework.runtime import Framework
+
+    unreserved = []
+
+    class Gate(PermitPlugin):
+        def permit(self, state, pod, node):
+            return Status(Code.WAIT), 10.0
+
+    class Undo(UnreservePlugin):
+        def unreserve(self, state, pod, node):
+            unreserved.append((pod.key, pod.node_name, node))
+
+    clock = FakeClock()
+    fw = Framework(
+        registry={"Gate": lambda cfg: Gate(), "Undo": lambda cfg: Undo()},
+        plugins=Plugins(permit=PluginSet(enabled=["Gate"]),
+                        unreserve=PluginSet(enabled=["Undo"])),
+        clock=clock)
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder, framework=fw, clock=clock)
+    s.on_node_add(mknode("n0"))
+    s.on_pod_add(mkpod("w"))
+    st = s.schedule_pending()
+    assert st.scheduled == 0 and s.cache.is_assumed("default/w")
+    assert [p.key for p in fw.waiting_pods()] == ["default/w"]
+
+    clock.t = 11.0  # past the 10 s permit timeout
+    assert s.expire_waiting() == 1
+    # unreserved exactly once, with the ORIGINAL pod (no node stamped on it)
+    assert unreserved == [("default/w", "", "n0")]
+    # forgotten exactly once: the assume is gone from the cache
+    assert not s.cache.is_assumed("default/w")
+    assert s.cache.get_pod("default/w") is None
+    # requeued exactly once: one entry total across the retry queues
+    assert sum(s.queue.lengths()) == 1
+    assert binder.bound == []
+    # second pass: nothing left to expire, nothing double-requeued
+    assert s.expire_waiting() == 0
+    assert unreserved == [("default/w", "", "n0")]
+    assert sum(s.queue.lengths()) == 1
+
+
+# --------------------------------------------------------------------- #
+# faultline spec parsing
+# --------------------------------------------------------------------- #
+
+
+def test_faultline_spec_grammar():
+    fl = faultline.FaultLine(
+        "device.hang@cycle:3,watch.drop@0.5,native.dlopen,dev.x@probe:2+",
+        seed=3)
+    # site:N — exactly the Nth hit at that site
+    assert [fl.should("device.hang", "cycle") for _ in range(4)] == \
+        [False, False, True, False]
+    assert fl.should("device.hang", "probe") is False  # other site: no hit
+    # bare — always
+    assert all(fl.should("native.dlopen", s) for s in ("a", "b", ""))
+    # site:N+ — persistent from the Nth hit on
+    assert [fl.should("dev.x", "probe") for _ in range(4)] == \
+        [False, True, True, True]
+    # probability — seeded, some fire and some don't over enough trials
+    fired = sum(fl.should("watch.drop") for _ in range(100))
+    assert 20 < fired < 80
+    assert fl.fired("device.hang") == 1
+    with pytest.raises(faultline.FaultSpecError):
+        faultline.parse_spec("bad@cycle:x")
+    with pytest.raises(faultline.FaultSpecError):
+        faultline.parse_spec("@0.5")

@@ -199,6 +199,51 @@ class TestProxier:
                    for _ in range(5))
 
 
+class TestKubectlPatch:
+    def test_patch_strategic_merge_and_json_dialects(self):
+        """kubectl patch with all three dialects (VERDICT §1 layer 10: the
+        verb was missing): strategic merges container lists by name, json
+        applies RFC 6902 ops, merge accepts YAML bodies."""
+        api = APIServer()
+        try:
+            client = Client.local(api)
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "p", "namespace": "default",
+                             "labels": {"a": "1"}},
+                "spec": {"containers": [
+                    {"name": "c1", "image": "img:v1"},
+                    {"name": "c2", "image": "sidecar:v1"}]}})
+            out = io.StringIO()
+            # strategic (default): container list merges BY NAME — c2 stays
+            assert kubectl_main(
+                ["patch", "pods", "p", "-p",
+                 '{"spec":{"containers":[{"name":"c1","image":"img:v2"}]}}'],
+                client=client, out=out) == 0
+            assert "pod/p patched" in out.getvalue()
+            live = client.pods.get("p", "default")
+            imgs = {c["name"]: c["image"]
+                    for c in live["spec"]["containers"]}
+            assert imgs == {"c1": "img:v2", "c2": "sidecar:v1"}
+            # json: RFC 6902 op list
+            assert kubectl_main(
+                ["patch", "pods", "p", "--type", "json", "-p",
+                 '[{"op":"replace","path":"/metadata/labels/a",'
+                 '"value":"2"}]'],
+                client=client, out=out) == 0
+            assert client.pods.get(
+                "p", "default")["metadata"]["labels"]["a"] == "2"
+            # merge: RFC 7386, YAML body accepted like kubectl's -p
+            assert kubectl_main(
+                ["patch", "pods", "p", "--type", "merge", "-p",
+                 'metadata:\n  labels:\n    b: "3"'],
+                client=client, out=out) == 0
+            labels = client.pods.get("p", "default")["metadata"]["labels"]
+            assert labels["b"] == "3" and labels["a"] == "2"
+        finally:
+            api.close()
+
+
 class TestKubectlAndCluster:
     def test_kubectl_against_live_cluster(self, tmp_path):
         with Cluster(ClusterConfig(hollow_nodes=2)) as cluster:
