@@ -18,32 +18,123 @@ by data. The TPU-native design shards the **node axis** across chips with a
 Pod-axis (batch) sharding — the long-context analog — composes on top for the
 class-level matrices when SC×N outgrows one chip's HBM; the scan itself stays
 sequential in pods by design (assume semantics).
+
+Serving integration (the live path, not just the dryrun): `MeshState` owns
+the mesh the scheduler dispatches on — `state/cache.py` keeps the encoded
+`ClusterTables` RESIDENT on it (node axis split, patched with donated
+scatters), `sched/prewarm.py` keys executables on the mesh signature, and
+`sched/supervisor.py` drops/reforms the mesh across backend loss.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import threading
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..state.arrays import ClusterTables, PodArrays
+from ..state.arrays import ClusterTables, NodeArrays
 
 NODE_AXIS = "nodes"
+
+XLA_MESH_HINT = (
+    "set XLA_FLAGS=--xla_force_host_platform_device_count=<n> and "
+    "JAX_PLATFORMS=cpu for a virtual mesh"
+)
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
     if len(devs) < n:
-        raise RuntimeError(
+        err = RuntimeError(
             f"make_mesh({n}): only {len(devs)} devices visible — a multichip "
-            "proof run on fewer devices than requested would validate nothing "
-            "(set XLA_FLAGS=--xla_force_host_platform_device_count and "
-            "JAX_PLATFORMS=cpu for a virtual mesh)"
+            "proof run on fewer devices than requested would validate nothing"
         )
+        # PEP 678 notes: the actionable hint rides on the exception even
+        # through re-raise/wrapping layers (3.10 tracebacks don't print
+        # __notes__, so the hint is also queryable: err.__notes__)
+        err.__notes__ = [XLA_MESH_HINT]
+        raise err
     return Mesh(np.array(devs[:n]), (NODE_AXIS,))
+
+
+def mesh_key(mesh: Optional[Mesh]) -> Optional[Tuple]:
+    """Hashable signature of a mesh for executable/budget keying: shape and
+    the concrete device ids. Two meshes with the same shape over DIFFERENT
+    devices (pre- vs post-reform) must not share compiled programs — the old
+    executable is pinned to the lost devices."""
+    if mesh is None:
+        return None
+    return (mesh.devices.shape,
+            tuple(d.id for d in mesh.devices.flat))
+
+
+def padded_node_count(n: int, n_devices: int) -> int:
+    """Smallest multiple of n_devices ≥ n."""
+    return ((n + n_devices - 1) // n_devices) * n_devices
+
+
+def pad_node_tables(tables: ClusterTables, n_devices: int) -> ClusterTables:
+    """Pad the node axis with inert rows (valid=False, zero capacity, every
+    id -1 — the same fill as Encoder.empty_node_arrays' unoccupied slots) so
+    N divides the mesh evenly. Inert rows are masked by `nodes.valid`
+    everywhere the engines look, so they can never admit a pod; the padding
+    test (tests/test_mesh.py) holds that to zero phantom admissions."""
+    N = int(tables.nodes.valid.shape[0])
+    Np = padded_node_count(N, n_devices)
+    if Np == N:
+        return tables
+    pad = Np - N
+
+    def _pad(a):
+        a = np.asarray(a)
+        fill = np.zeros((pad,) + a.shape[1:], a.dtype)
+        if a.dtype == np.int32:
+            # id columns pad with -1 (absent); count/usage columns with 0.
+            # -1 is the safe universal fill for an INVALID row: every
+            # consumer is already gated on nodes.valid, and -1 matches the
+            # empty_node_arrays convention for id planes
+            fill[:] = -1
+        return np.concatenate([a, fill], axis=0)
+
+    nodes = NodeArrays(
+        valid=_pad(tables.nodes.valid),
+        name_id=_pad(tables.nodes.name_id),
+        alloc=np.concatenate([np.asarray(tables.nodes.alloc),
+                              np.zeros((pad,) + np.asarray(
+                                  tables.nodes.alloc).shape[1:],
+                                  np.asarray(tables.nodes.alloc).dtype)]),
+        used=np.concatenate([np.asarray(tables.nodes.used),
+                             np.zeros((pad,) + np.asarray(
+                                 tables.nodes.used).shape[1:],
+                                 np.asarray(tables.nodes.used).dtype)]),
+        label_keys=_pad(tables.nodes.label_keys),
+        label_vals=_pad(tables.nodes.label_vals),
+        label_ints=np.concatenate([np.asarray(tables.nodes.label_ints),
+                                   np.zeros((pad,) + np.asarray(
+                                       tables.nodes.label_ints).shape[1:],
+                                       np.int32)]),
+        unschedulable=np.concatenate([np.asarray(tables.nodes.unschedulable),
+                                      np.ones((pad,), bool)]),
+        taint_keys=_pad(tables.nodes.taint_keys),
+        taint_vals=_pad(tables.nodes.taint_vals),
+        taint_effects=_pad(tables.nodes.taint_effects),
+        topo=_pad(tables.nodes.topo),
+        domain=_pad(tables.nodes.domain),
+        port_pair_any=_pad(tables.nodes.port_pair_any),
+        port_pair_wild=_pad(tables.nodes.port_pair_wild),
+        port_triple=_pad(tables.nodes.port_triple),
+        img_words=_pad(tables.nodes.img_words),
+        vol_any=_pad(tables.nodes.vol_any),
+        vol_rw=_pad(tables.nodes.vol_rw),
+        vol_limit=_pad(tables.nodes.vol_limit),
+        avoid=np.concatenate([np.asarray(tables.nodes.avoid),
+                              np.zeros((pad,), bool)]),
+    )
+    return tables._replace(nodes=nodes)
 
 
 def _node_sharded_tables_spec(tables: ClusterTables) -> ClusterTables:
@@ -69,9 +160,23 @@ def _node_sharded_tables_spec(tables: ClusterTables) -> ClusterTables:
     )
 
 
+def table_shardings(tables: ClusterTables, mesh: Mesh) -> ClusterTables:
+    """NamedSharding pytree matching `shard_tables`' placement — shared by
+    the live placement path (state/cache.py) and the AOT prewarm path
+    (sched/prewarm.py builds ShapeDtypeStructs carrying these)."""
+    specs = _node_sharded_tables_spec(tables)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
 def shard_tables(tables: ClusterTables, mesh: Mesh) -> ClusterTables:
-    """Place tables on the mesh: node axis split across chips, rest replicated.
-    Requires dims.N % n_devices == 0 (bucketed capacities make this easy)."""
+    """Place tables on the mesh: node axis split across chips, rest
+    replicated. When dims.N does not divide the mesh evenly, the node axis is
+    padded with inert rows first (zero capacity, invalid, unschedulable) —
+    bucketed capacities make the divisible case the common one, but a raw
+    Dims(N=...) from a caller must not crash the mesh path."""
+    nd = len(mesh.devices.flat)
+    tables = pad_node_tables(tables, nd)
     specs = _node_sharded_tables_spec(tables)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tables, specs
@@ -82,3 +187,79 @@ def replicate(tree, mesh: Mesh):
     return jax.tree.map(
         lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree
     )
+
+
+class MeshState:
+    """The serving scheduler's mesh lifecycle (sched/supervisor.py owns the
+    health transitions):
+
+      * `mesh` — the live mesh the next snapshot/dispatch should use, or
+        None (single-device serving, exactly the pre-mesh behavior).
+      * `on_backend_loss()` — a device of the mesh died (XlaRuntimeError,
+        watchdog timeout): the WHOLE mesh is untrusted (GSPMD collectives
+        span every chip), so serving drops to the supervisor's single-device
+        CPU fallback immediately. The lost width is remembered.
+      * `reform()` — re-admission: rebuild a mesh from the devices that are
+        live NOW. After a loss the reformed mesh is SMALLER (largest power of
+        two strictly below the lost width — the failed chip cannot be
+        re-trusted blindly) unless the prober proved full width, in which
+        case `reform(full=True)` restores it. A fresh Mesh object is built
+        either way: state/cache.py keys residency on mesh identity, so
+        reform forces the re-shard-from-host-staging path by construction.
+
+    Device counts stay powers of two so the bucketed node axis (state/dims.py
+    grown_for keeps N pow2-friendly) divides evenly without padding in the
+    steady state; `shard_tables` pads when a raw shape doesn't."""
+
+    def __init__(self, n_devices: Optional[int] = None):
+        self._mu = threading.Lock()
+        self._requested = n_devices
+        self._lost_width: Optional[int] = None
+        self.reforms = 0
+        self.demotions = 0
+        m = None
+        avail = len(jax.devices())
+        want = n_devices or avail
+        if want > 1 and avail >= 2:
+            m = make_mesh(self._pow2_floor(min(want, avail)))
+        self.mesh: Optional[Mesh] = m
+
+    @staticmethod
+    def _pow2_floor(n: int) -> int:
+        return 1 << (max(n, 1).bit_length() - 1)
+
+    @property
+    def n_devices(self) -> int:
+        with self._mu:
+            return len(self.mesh.devices.flat) if self.mesh is not None else 1
+
+    def on_backend_loss(self) -> None:
+        """A mesh device is gone: drop the mesh entirely (collectives span
+        all chips — there is no partial trust) and remember the width so
+        reform comes back narrower."""
+        with self._mu:
+            if self.mesh is None:
+                return
+            self._lost_width = len(self.mesh.devices.flat)
+            self.mesh = None
+            self.demotions += 1
+
+    def reform(self, full: bool = False) -> Optional[Mesh]:
+        """Rebuild the mesh on re-admission. `full=True` (the prober proved
+        every device answers) restores the requested width; otherwise the
+        reformed mesh halves the lost width — losing one device of an 8-way
+        mesh serves on 4 until a full-width probe passes."""
+        with self._mu:
+            avail = len(jax.devices())
+            want = self._requested or avail
+            if not full and self._lost_width is not None:
+                want = min(want, max(self._lost_width // 2, 1))
+            want = self._pow2_floor(min(want, avail))
+            if want <= 1:
+                self.mesh = None
+                return None
+            self.mesh = make_mesh(want)
+            if full:
+                self._lost_width = None
+            self.reforms += 1
+            return self.mesh

@@ -30,21 +30,33 @@ UNSCHEDULABLE_TAINT_KEY = "node.kubernetes.io/unschedulable"  # predicates.go:15
 
 
 def snapshot_with_keys(cache, encoder: Encoder, pending, base_dims,
-                       device=None):
+                       device=None, mesh=None):
     """Snapshot + the interned synthetic-taint key ids every device dispatch
     needs — the single home for the UNSCHEDULABLE_TAINT_KEY interning ritual
     (shared by the scheduler wave path and the extender backend). `device`
     routes the arrays to an explicit placement (the supervisor's degraded
-    mode: everything onto the CPU fallback, nothing on the lost backend)."""
+    mode: everything onto the CPU fallback, nothing on the lost backend);
+    `mesh` routes them to mesh-resident sharded placement instead (the live
+    multichip serving path — state/cache.py keeps the tables resident)."""
     snap = cache.snapshot(encoder, pending, base_dims,
                           extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
-                          device=device)
+                          device=device, mesh=mesh)
     encoder.vocabs.label_vals.intern("")
-    # the scalars are created ON the routed device — a jnp constructor on
-    # the default (possibly dead) backend is exactly what degraded mode
-    # must never touch
+    # the scalars are created ON the routed placement — a jnp constructor
+    # on the default (possibly dead) backend is exactly what degraded mode
+    # must never touch, and a single-device scalar next to mesh-resident
+    # tables would force GSPMD to re-commit it every dispatch
     import contextlib
 
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        uk = jax.device_put(
+            jnp.int32(encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY)),
+            rep)
+        ev = jax.device_put(jnp.int32(encoder.vocabs.label_vals.get("")), rep)
+        return snap, (uk, ev)
     ctx = jax.default_device(device) if device is not None \
         else contextlib.nullcontext()
     with ctx:
@@ -227,7 +239,8 @@ def _schedule_batch(tables, pending, keys, D, existing,
                     gang=None,
                     return_waves: bool = False,
                     dims=None,
-                    prewarmer=None):
+                    prewarmer=None,
+                    mesh=None):
     engine = _engine()
     if gang is not None and engine != "scan" and not has_node_name \
             and pending.valid.shape[0] >= _GANG_HOST_THRESHOLD:
@@ -254,9 +267,13 @@ def _schedule_batch(tables, pending, keys, D, existing,
     if prewarmer is not None and dims is not None and not return_waves:
         # prewarmed executable for this exact signature: calling the stored
         # jax Compiled skips trace+lower+compile — the boundary cycle right
-        # after a capacity-bucket crossing stays in budget (sched/prewarm.py)
+        # after a capacity-bucket crossing stays in budget (sched/prewarm.py).
+        # The key carries the MESH signature: a mesh-sharded program and a
+        # single-device one at the same Dims are different executables, and
+        # invoking one with the other's arrays would silently reshard onto
+        # (possibly dead) devices — lookup isolation makes that impossible.
         compiled = prewarmer.lookup(dims, engine, extra_plugins,
-                                    gang is not None)
+                                    gang is not None, mesh=mesh)
         if compiled is not None:
             try:
                 return compiled(tables, pending, keys, existing, hw, ecfg,

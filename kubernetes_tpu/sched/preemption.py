@@ -234,7 +234,12 @@ class Preemptor:
                         np.asarray(jax.device_get(res.n_pdb_violations)))
 
             def _primary():
-                compiled = prewarmer.lookup_preempt(snap.dims, B) \
+                # the lookup carries the snapshot's mesh signature: a
+                # mesh-sharded burst program must never be fed
+                # single-device arrays (and vice versa) — see
+                # sched/prewarm.py lookup isolation
+                compiled = prewarmer.lookup_preempt(snap.dims, B,
+                                                    mesh=snap.mesh) \
                     if prewarmer is not None else None
                 if compiled is not None:
                     try:
@@ -271,12 +276,14 @@ class Preemptor:
             if supervisor is not None:
                 from dataclasses import replace as _dc_replace
 
+                from ..parallel.mesh import mesh_key as _mesh_key
                 from .supervisor import DispatchAbandonedError
 
                 try:
                     nodes_b, victims_b, npdb_b = supervisor.run(
                         "preempt",
-                        (_dc_replace(snap.dims, has_node_name=False, P=1), B),
+                        (_dc_replace(snap.dims, has_node_name=False, P=1), B,
+                         _mesh_key(snap.mesh)),
                         _primary, _fallback)
                 except DispatchAbandonedError:
                     # both backends refused the burst: NOTHING in this chunk

@@ -36,12 +36,39 @@ from ..state.dims import Dims
 _GROWTH_AXES = ("N", "E")
 
 
-def abstract_cycle_args(d: Dims, gang: bool = False):
+def _abstract_tables(tables, mesh):
+    """(abstract ClusterTables, replicated-sharding-or-None) — the shared
+    half of abstract_cycle_args / abstract_preempt_args. With a mesh, the
+    node tables carry the node-axis NamedShardings and everything else the
+    replicated one, so both AOT paths compile the SAME GSPMD placement the
+    live mesh path dispatches; layout changes live in parallel/mesh.py
+    table_shardings, in exactly one place."""
+    import jax
+
+    if mesh is None:
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tables), None
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.mesh import table_shardings
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    tsh = table_shardings(tables, mesh)
+    abstract = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        tables, tsh)
+    return abstract, rep
+
+
+def abstract_cycle_args(d: Dims, gang: bool = False, mesh=None):
     """ShapeDtypeStruct pytrees for one _schedule_batch_impl call at dims
     `d` — built from a throwaway Encoder's empty tables, so shapes/dtypes
     and pytree structure are BY CONSTRUCTION the ones the live path passes.
     `gang=True` adds abstract GangArrays (gang-bearing batches trace a
-    structurally different program — the restart loop)."""
+    structurally different program — the restart loop). `mesh` attaches the
+    serving shardings (node axis split on the tables, everything else
+    replicated — parallel/mesh.py), so the AOT compile produces the SAME
+    GSPMD-partitioned executable the live mesh path dispatches."""
     import jax
     import jax.numpy as jnp
 
@@ -67,29 +94,32 @@ def abstract_cycle_args(d: Dims, gang: bool = False):
     )
     pending = enc.build_pod_arrays([], d, capacity=d.P)
     existing = enc.build_pod_arrays([], d, capacity=d.E)
+    abstract_tables, rep = _abstract_tables(tables, mesh)
     abstract = lambda t: jax.tree.map(
-        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
-    scalar_i32 = jax.ShapeDtypeStruct((), jnp.int32)
-    scalar_f32 = jax.ShapeDtypeStruct((), jnp.float32)
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep), t)
+    scalar_i32 = jax.ShapeDtypeStruct((), jnp.int32, sharding=rep)
+    scalar_f32 = jax.ShapeDtypeStruct((), jnp.float32, sharding=rep)
     gang_args = None
     if gang:
         gang_args = GangArrays(
-            group=jax.ShapeDtypeStruct((d.P,), jnp.int32),
-            needed=jax.ShapeDtypeStruct((d.GR,), jnp.int32),
-            valid=jax.ShapeDtypeStruct((d.GR,), jnp.bool_),
-            rank=jax.ShapeDtypeStruct((d.GR,), jnp.int32),
+            group=jax.ShapeDtypeStruct((d.P,), jnp.int32, sharding=rep),
+            needed=jax.ShapeDtypeStruct((d.GR,), jnp.int32, sharding=rep),
+            valid=jax.ShapeDtypeStruct((d.GR,), jnp.bool_, sharding=rep),
+            rank=jax.ShapeDtypeStruct((d.GR,), jnp.int32, sharding=rep),
         )
-    return (abstract(tables), abstract(pending), (scalar_i32, scalar_i32),
+    return (abstract_tables, abstract(pending), (scalar_i32, scalar_i32),
             abstract(existing), scalar_f32,
             jax.tree.map(lambda _: scalar_f32, default_engine_config()),
             gang_args)
 
 
-def abstract_preempt_args(d: Dims, burst: int):
+def abstract_preempt_args(d: Dims, burst: int, mesh=None):
     """ShapeDtypeStruct pytrees for one sched.preemption._preempt call at
     dims `d` with a preemptor burst of `burst` lanes — the preemption analog
     of abstract_cycle_args, so the burst program can compile in the
-    background BEFORE the first preemption storm hits the live path."""
+    background BEFORE the first preemption storm hits the live path. `mesh`
+    attaches the serving shardings (the burst's what-if runs over the SAME
+    mesh-resident tables as the wave cycle)."""
     import jax
     import jax.numpy as jnp
 
@@ -113,13 +143,14 @@ def abstract_preempt_args(d: Dims, burst: int):
         drv_masks=enc.build_drv_masks(d),
     )
     existing = enc.build_pod_arrays([], d, capacity=d.E)
+    abstract_tables, rep = _abstract_tables(tables, mesh)
     abstract = lambda t: jax.tree.map(
-        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
-    scalar_i32 = jax.ShapeDtypeStruct((), jnp.int32)
-    scalar_f32 = jax.ShapeDtypeStruct((), jnp.float32)
-    vec_i32 = jax.ShapeDtypeStruct((burst,), jnp.int32)
-    pdb = jax.ShapeDtypeStruct((d.E,), jnp.bool_)
-    return (abstract(tables), abstract(existing), vec_i32, vec_i32, vec_i32,
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep), t)
+    scalar_i32 = jax.ShapeDtypeStruct((), jnp.int32, sharding=rep)
+    scalar_f32 = jax.ShapeDtypeStruct((), jnp.float32, sharding=rep)
+    vec_i32 = jax.ShapeDtypeStruct((burst,), jnp.int32, sharding=rep)
+    pdb = jax.ShapeDtypeStruct((d.E,), jnp.bool_, sharding=rep)
+    return (abstract_tables, abstract(existing), vec_i32, vec_i32, vec_i32,
             (scalar_i32, scalar_i32), pdb, scalar_f32,
             jax.tree.map(lambda _: scalar_f32, default_engine_config()))
 
@@ -171,14 +202,22 @@ class BucketPrewarmer:
         # machinery reacts to them exactly as to a failed live dispatch
         self.supervisor = None
 
+    @staticmethod
+    def _mesh_sig(mesh):
+        from ..parallel.mesh import mesh_key
+
+        return mesh_key(mesh)
+
     def observe(self, d: Dims, n_nodes: int, n_existing: int,
                 engine: str = "waves", extras: tuple = (),
-                gang: bool = False) -> None:
+                gang: bool = False, mesh=None) -> None:
         """Call once per cycle with live occupancy (and whether batches are
-        gang-bearing — gangs trace a different program). Cheap when nothing
-        is near a boundary. Warms one target per call; multiple crossing
-        axes warm on successive cycles (single-axis targets first — the
-        common case is one axis crossing at a time — then the joint one)."""
+        gang-bearing — gangs trace a different program; and which mesh the
+        cycle dispatches on — a sharded program is a different executable).
+        Cheap when nothing is near a boundary. Warms one target per call;
+        multiple crossing axes warm on successive cycles (single-axis
+        targets first — the common case is one axis crossing at a time —
+        then the joint one)."""
         if not self.enabled:
             return
         live = {"N": n_nodes, "E": n_existing}
@@ -191,10 +230,12 @@ class BucketPrewarmer:
         if len(crossing) > 1:
             targets.append(d.grown_for(
                 **{ax: getattr(d, ax) + 1 for ax in crossing}))
+        msig = self._mesh_sig(mesh)
         for target in targets:
             if target == d:
                 continue
-            key = (replace(target, has_node_name=False), engine, extras, gang)
+            key = (replace(target, has_node_name=False), engine, extras,
+                   gang, msig)
             with self._mu:
                 if key in self._warmed:
                     continue
@@ -203,7 +244,7 @@ class BucketPrewarmer:
                 self._warmed.add(key)
                 t = threading.Thread(
                     target=self._compile_fn,
-                    args=(target, engine, extras, gang),
+                    args=(target, engine, extras, gang, mesh),
                     name=f"ktpu-prewarm-{target.N}x{target.E}", daemon=True)
                 # start BEFORE publishing: wait() joins _inflight without
                 # the lock, and joining a not-yet-started thread raises
@@ -212,8 +253,9 @@ class BucketPrewarmer:
             return
 
     def _compile(self, d: Dims, engine: str, extras: tuple,
-                 gang: bool) -> None:
-        key = (replace(d, has_node_name=False), engine, extras, gang)
+                 gang: bool, mesh=None) -> None:
+        key = (replace(d, has_node_name=False), engine, extras, gang,
+               self._mesh_sig(mesh))
         epoch = self._epoch
         try:
             from ..utils import faultline
@@ -224,7 +266,7 @@ class BucketPrewarmer:
                 raise InjectedDeviceError(
                     "injected XlaRuntimeError at prewarm")
             (tables, pending, keys, existing, hw, ecfg,
-             gang_args) = abstract_cycle_args(d, gang=gang)
+             gang_args) = abstract_cycle_args(d, gang=gang, mesh=mesh)
             compiled = _schedule_batch_impl.lower(
                 tables, pending, keys, d.D, existing, engine, hw, ecfg,
                 extras, tuple(1.0 for _ in extras), gang_args,
@@ -248,11 +290,16 @@ class BucketPrewarmer:
             if self.supervisor is not None:
                 self.supervisor.note_compile_failure(e)
 
-    def lookup(self, d: Dims, engine: str, extras: tuple, gang: bool):
+    def lookup(self, d: Dims, engine: str, extras: tuple, gang: bool,
+               mesh=None):
         """The stored Compiled for this cycle signature, or None. Called on
-        the dispatch hot path — one dict probe."""
+        the dispatch hot path — one dict probe. The mesh signature is part
+        of the key, so a single-device caller can NEVER receive a
+        mesh-sharded executable (or vice versa) — the isolation that keeps
+        a degraded wave from resharding its arrays onto lost devices."""
         return self.compiled.get(
-            (replace(d, has_node_name=False), engine, extras, gang))
+            (replace(d, has_node_name=False), engine, extras, gang,
+             self._mesh_sig(mesh)))
 
     def invalidate(self) -> None:
         """Drop every stored executable and warm record, and fence out
@@ -266,33 +313,37 @@ class BucketPrewarmer:
             self._warmed.clear()
 
     def rewarm(self, d: Dims, engine: str = "waves", extras: tuple = (),
-               gang: bool = False) -> bool:
+               gang: bool = False, mesh=None) -> bool:
         """Force a background compile of the CURRENT dims regardless of
         occupancy thresholds — the backend re-admission path: the recovered
         device's first wave should deserialize a warm executable, not pay a
-        cold compile on the hot path. If a compile is already in flight the
-        rewarm CHAINS behind it (one compile at a time still holds) rather
-        than being dropped. Returns True when the compile ran or was
-        scheduled."""
+        cold compile on the hot path. `mesh` is the mesh the NEXT wave will
+        dispatch on (the supervisor passes the post-reform mesh, which may
+        be narrower than the lost one — never the dead signature). If a
+        compile is already in flight the rewarm CHAINS behind it (one
+        compile at a time still holds) rather than being dropped. Returns
+        True when the compile ran or was scheduled."""
         if not self.enabled:
             return False
         if max(d.N, d.E) < self.min_axis:
             return False  # small shapes recompile in seconds on demand
-        key = (replace(d, has_node_name=False), engine, extras, gang)
+        key = (replace(d, has_node_name=False), engine, extras, gang,
+               self._mesh_sig(mesh))
         with self._mu:
             self._warmed.add(key)
             prev = self._inflight
             if prev is not None and prev.is_alive():
                 def chained():
                     prev.join()
-                    self._compile_fn(d, engine, extras, gang)
+                    self._compile_fn(d, engine, extras, gang, mesh)
 
                 t = threading.Thread(
                     target=chained,
                     name=f"ktpu-rewarm-{d.N}x{d.E}", daemon=True)
             else:
                 t = threading.Thread(
-                    target=self._compile_fn, args=(d, engine, extras, gang),
+                    target=self._compile_fn,
+                    args=(d, engine, extras, gang, mesh),
                     name=f"ktpu-rewarm-{d.N}x{d.E}", daemon=True)
             # start BEFORE publishing (wait() joins without the lock; a
             # not-yet-started thread would raise there). rewarm runs on the
@@ -303,28 +354,29 @@ class BucketPrewarmer:
 
     # ---- preemption-burst program (sched/preemption.py _preempt) ---- #
 
-    @staticmethod
-    def _preempt_key(d: Dims, burst: int):
+    @classmethod
+    def _preempt_key(cls, d: Dims, burst: int, mesh=None):
         # the burst program never sees the pending arrays, so P (and the
         # per-batch has_node_name flag) must not split the key: the warm
         # happens against the WAVE snapshot's dims while the lookup uses
         # the preemption pass's fresh snapshot — any P drift between the
         # two would orphan the prewarmed executable exactly when a storm
         # needs it
-        return ("preempt", replace(d, has_node_name=False, P=1), burst)
+        return ("preempt", replace(d, has_node_name=False, P=1), burst,
+                cls._mesh_sig(mesh))
 
-    def observe_preempt(self, d: Dims, burst: int) -> None:
+    def observe_preempt(self, d: Dims, burst: int, mesh=None) -> None:
         """Warm the preemption-burst program for the CURRENT dims in the
         background. Unlike the cycle program (compiled by the first wave),
         nothing compiles the preempt what-if until the first preemption
         storm — which is exactly when a multi-second compile stall hurts
         most. The scheduler calls this once per steady cycle; each
-        (dims, burst) signature compiles at most once."""
+        (dims, burst, mesh) signature compiles at most once."""
         if not self.enabled:
             return
         if max(d.N, d.E) < self.min_axis:
             return
-        key = self._preempt_key(d, burst)
+        key = self._preempt_key(d, burst, mesh)
         with self._mu:
             if key in self._warmed:
                 return
@@ -333,13 +385,13 @@ class BucketPrewarmer:
                 return  # one preempt compile at a time; retry next cycle
             self._warmed.add(key)
             t = threading.Thread(
-                target=self._compile_preempt, args=(d, burst),
+                target=self._compile_preempt, args=(d, burst, mesh),
                 name=f"ktpu-prewarm-preempt-{d.N}x{d.E}", daemon=True)
             t.start()  # before publishing: see observe()
             self._inflight_preempt = t
 
-    def _compile_preempt(self, d: Dims, burst: int) -> None:
-        key = self._preempt_key(d, burst)
+    def _compile_preempt(self, d: Dims, burst: int, mesh=None) -> None:
+        key = self._preempt_key(d, burst, mesh)
         epoch = self._epoch
         try:
             from ..utils import faultline
@@ -350,7 +402,7 @@ class BucketPrewarmer:
                 raise InjectedDeviceError(
                     "injected XlaRuntimeError at prewarm")
             (tables, existing, cls, nnr, prio, keys, pdb,
-             hw, ecfg) = abstract_preempt_args(d, burst)
+             hw, ecfg) = abstract_preempt_args(d, burst, mesh=mesh)
             compiled = _preempt.lower(
                 tables, existing, cls, nnr, prio, d.D, keys, pdb, hw, ecfg,
             ).compile()
@@ -369,8 +421,8 @@ class BucketPrewarmer:
             if self.supervisor is not None:
                 self.supervisor.note_compile_failure(e)
 
-    def lookup_preempt(self, d: Dims, burst: int):
-        return self.compiled.get(self._preempt_key(d, burst))
+    def lookup_preempt(self, d: Dims, burst: int, mesh=None):
+        return self.compiled.get(self._preempt_key(d, burst, mesh))
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Test/shutdown helper: join the in-flight compiles."""

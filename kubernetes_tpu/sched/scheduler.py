@@ -90,6 +90,7 @@ class Scheduler:
         preemptor: Optional["object"] = None,
         extenders: Sequence["object"] = (),
         framework: Optional["object"] = None,
+        mesh: object = None,
     ) -> None:
         self.binder = binder
         self.cache = cache or SchedulerCache()
@@ -145,14 +146,45 @@ class Scheduler:
         from .prewarm import BucketPrewarmer
 
         self.prewarmer = BucketPrewarmer()
+        # live mesh serving (parallel/mesh.py): `mesh` may be a MeshState,
+        # a device count, or "auto" (all visible devices); None consults
+        # KTPU_MESH (unset/0 = single-device serving, the pre-mesh
+        # behavior). With a mesh, snapshots keep ClusterTables RESIDENT
+        # sharded across it (node axis split) and the wave/preempt/score
+        # programs compile under GSPMD sharding annotations.
+        self.mesh_state = self._make_mesh_state(mesh)
         # every XLA call (wave dispatch, preemption burst, extender scores,
         # background compiles) runs under the dispatch supervisor: deadline
-        # watchdog, CPU degradation on backend loss, prober re-admission
-        # (sched/supervisor.py)
+        # watchdog, CPU degradation on backend loss, prober re-admission,
+        # mesh drop/reform across device loss (sched/supervisor.py)
         from .supervisor import DispatchSupervisor
 
-        self.supervisor = DispatchSupervisor(prewarmer=self.prewarmer)
+        self.supervisor = DispatchSupervisor(prewarmer=self.prewarmer,
+                                             mesh_state=self.mesh_state)
         self.prewarmer.supervisor = self.supervisor
+
+    @staticmethod
+    def _make_mesh_state(mesh):
+        import os
+
+        from ..parallel.mesh import MeshState
+
+        if mesh is None:
+            env = os.environ.get("KTPU_MESH", "")
+            if not env or env in ("0", "off"):
+                return None
+            mesh = env
+        if isinstance(mesh, MeshState):
+            return mesh
+        if isinstance(mesh, str):
+            n = None if mesh == "auto" else int(mesh)
+            return MeshState(n)
+        if isinstance(mesh, int):
+            return MeshState(mesh) if mesh > 1 else None
+        # a raw jax.sharding.Mesh: adopt it as the live mesh
+        ms = MeshState(len(mesh.devices.flat))
+        ms.mesh = mesh
+        return ms
 
     # ------------------------------------------------------------------ #
     # event handlers (eventhandlers.go)
@@ -223,10 +255,13 @@ class Scheduler:
 
         # degraded mode routes the snapshot (and the interned-key scalars)
         # onto the CPU fallback device: host staging is the ground truth,
-        # so nothing on this path touches the lost backend's buffers
+        # so nothing on this path touches the lost backend's buffers.
+        # Healthy mesh serving routes them to mesh-resident sharded
+        # placement instead (snapshot_mesh() is None while degraded).
         return snapshot_with_keys(self.cache, self.encoder, pending,
                                   self.base_dims,
-                                  device=self.supervisor.snapshot_device())
+                                  device=self.supervisor.snapshot_device(),
+                                  mesh=self.supervisor.snapshot_mesh())
 
     def schedule_pending(self, now: Optional[float] = None) -> CycleStats:
         """One wave: pump → pop batch → snapshot → device cycle → commit.
@@ -275,7 +310,8 @@ class Scheduler:
             n_existing=self.cache.pod_count,
             engine=wave_engine,
             extras=extras,
-            gang=self._device_gangs and snap.gang is not None)
+            gang=self._device_gangs and snap.gang is not None,
+            mesh=snap.mesh)
         self.supervisor.note_cycle_signature(
             snap.dims, wave_engine, extras, gang_arg is not None)
 
@@ -286,7 +322,8 @@ class Scheduler:
                 hard_weight=self.hard_pod_affinity_weight,
                 ecfg=self.engine_config,
                 extra_plugins=extras, extra_weights=extra_w,
-                gang=gang_arg, dims=snap.dims, prewarmer=self.prewarmer)
+                gang=gang_arg, dims=snap.dims, prewarmer=self.prewarmer,
+                mesh=snap.mesh)
             return jax.device_get(res.node)
 
         # the commit loop must map node indices through the node_order of
@@ -337,46 +374,81 @@ class Scheduler:
         # a gang-bearing or scan-routed wave at a warm shape traces a new
         # XLA program whose cold compile must get the cold budget — keying
         # on dims alone would misread that compile as a hang and falsely
-        # mark a healthy backend lost
-        handle = self.supervisor.submit(
-            "cycle",
-            (_dc_replace(snap.dims, has_node_name=False), wave_engine,
-             extras, gang_arg is not None),
-            _primary, _fallback)
-        # ---- double-buffered host/device overlap: the dispatch above runs
-        # on the watchdog worker, so while the device evaluates THIS wave,
-        # the host interns the NEXT wave's backlog (the dominant host cost
-        # of the next snapshot). By the time handle.result() blocks, cycle
-        # N+1's pod rows are already memoized — encode of N+1 overlapped
-        # dispatch of N.
-        if self.preemptor is not None:
-            from .preemption import PREEMPT_BURST
+        # mark a healthy backend lost. The mesh signature is part of it:
+        # the GSPMD-partitioned program is a different compile.
+        from ..parallel.mesh import mesh_key as _mesh_key
 
-            # preemption storms compile their own fused program: warm it in
-            # the background at the current dims before the first storm
-            self.prewarmer.observe_preempt(snap.dims, PREEMPT_BURST)
-        backlog = self.queue.peek_active(self.batch_size)
-        if backlog:
-            self.encoder.intern_pods(backlog)
-        from .supervisor import DispatchAbandonedError
-
+        # the dispatch worker is about to hold this snapshot's arrays: the
+        # prestage snapshot below must take the copy path (back buffer),
+        # never donate buffers a thread is handing to XLA. EVERYTHING from
+        # here to readback sits inside the try so no exception path can
+        # leak the in-flight count (a leak would silently pin every later
+        # mesh patch onto the copy path — the donation contract's blind
+        # spot).
+        self.cache.mark_dispatch_start()
         try:
-            node_idx = handle.result()
-        except DispatchAbandonedError:
-            # crash-consistent wave abort: the dispatch died on BOTH
-            # backends before any readback, so nothing was assumed and
-            # nothing may be committed — forget the wave cleanly and
-            # requeue every popped pod (attempts preserved, prompt retry:
-            # the pods are fine, the backend wasn't). Without this, a
-            # dispatch death mid-wave would silently LOSE the whole batch.
-            for pod, attempts in batch:
-                stats.aborted += 1
-                self.queue.add_prompt_retry(pod, attempts=attempts, now=now)
-            for pod, attempts in ext_batch:
-                stats.aborted += 1
-                self.queue.add_prompt_retry(pod, attempts=attempts, now=now)
-            stats.cycle_seconds = time.perf_counter() - t0
-            return stats
+            handle = self.supervisor.submit(
+                "cycle",
+                (_dc_replace(snap.dims, has_node_name=False), wave_engine,
+                 extras, gang_arg is not None, _mesh_key(snap.mesh)),
+                _primary, _fallback)
+            # ---- double-buffered host/device overlap: the dispatch above
+            # runs on the watchdog worker, so while the device evaluates
+            # THIS wave, the host interns the NEXT wave's backlog (the
+            # dominant host cost of the next snapshot). By the time
+            # handle.result() blocks, cycle N+1's pod rows are already
+            # memoized — encode of N+1 overlapped dispatch of N.
+            if self.preemptor is not None:
+                from .preemption import PREEMPT_BURST
+
+                # preemption storms compile their own fused program: warm
+                # it in the background at the current dims before the
+                # first storm
+                self.prewarmer.observe_preempt(snap.dims, PREEMPT_BURST,
+                                               mesh=snap.mesh)
+            backlog = self.queue.peek_active(self.batch_size)
+            if backlog:
+                self.encoder.intern_pods(backlog)
+                if snap.mesh is not None:
+                    # mesh double-buffer, upload half: scatter the deltas
+                    # that accrued since the dispatched snapshot (informer
+                    # events, prior-wave confirms) into the BACK resident
+                    # buffer while the device evaluates THIS wave. The
+                    # post-readback snapshot then ships only the wave's
+                    # own assumes — the delta upload of cycle N+1
+                    # overlapped the dispatch of cycle N. Purely an
+                    # optimization: any failure here leaves the on-path
+                    # snapshot to do the same work after readback.
+                    try:
+                        self._snapshot_keys(backlog)
+                    except Exception:  # noqa: BLE001 - prestage must never
+                        pass           # take down the wave
+            from .supervisor import DispatchAbandonedError
+
+            try:
+                node_idx = handle.result()
+            except DispatchAbandonedError:
+                # crash-consistent wave abort: the dispatch died on BOTH
+                # backends before any readback, so nothing was assumed and
+                # nothing may be committed — forget the wave cleanly and
+                # requeue every popped pod (attempts preserved, prompt
+                # retry: the pods are fine, the backend wasn't). Without
+                # this, a dispatch death mid-wave would silently LOSE the
+                # whole batch.
+                for pod, attempts in batch:
+                    stats.aborted += 1
+                    self.queue.add_prompt_retry(pod, attempts=attempts,
+                                                now=now)
+                for pod, attempts in ext_batch:
+                    stats.aborted += 1
+                    self.queue.add_prompt_retry(pod, attempts=attempts,
+                                                now=now)
+                stats.cycle_seconds = time.perf_counter() - t0
+                return stats
+        finally:
+            # the dispatch no longer holds the snapshot's arrays — the
+            # next on-path mesh patch may donate the resident buffers
+            self.cache.mark_dispatch_done()
 
         failures: List[Tuple[Pod, int]] = []
         wave_order = wave_ctx["node_order"]  # set by a fallback re-encode
@@ -411,6 +483,7 @@ class Scheduler:
                     self.encoder, [p for p, _ in failures], self.base_dims,
                     extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
                     device=self.supervisor.snapshot_device(),
+                    mesh=self.supervisor.snapshot_mesh(),
                 )
                 handled_keys = self.preemptor.preempt_burst(
                     self, eligible, fresh, now)
@@ -483,9 +556,12 @@ class Scheduler:
                 return _score_on(args, score_ctx["D"])
 
         try:
+            from ..parallel.mesh import mesh_key as _mesh_key
+
             raw = self.supervisor.run(
                 "scores",
-                (_dc_replace(snap.dims, has_node_name=False), extras),
+                (_dc_replace(snap.dims, has_node_name=False), extras,
+                 _mesh_key(snap.mesh)),
                 lambda: _score_on((snap.tables, snap.pending, keys,
                                    snap.existing), snap.dims.D),
                 _score_fallback)
@@ -533,6 +609,7 @@ class Scheduler:
                     self.encoder, [pod], self.base_dims,
                     extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
                     device=self.supervisor.snapshot_device(),
+                    mesh=self.supervisor.snapshot_mesh(),
                 )
                 handled = self.preemptor.try_preempt(self, pod, attempts, fresh, now)
             if not handled:

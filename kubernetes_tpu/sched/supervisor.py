@@ -146,9 +146,17 @@ class DispatchSupervisor:
     submitted; the prober thread exists only while the backend is unhealthy."""
 
     def __init__(self, prewarmer=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 mesh_state=None):
         self.prewarmer = prewarmer
         self.clock = clock
+        # parallel/mesh.py MeshState when the scheduler serves on a device
+        # mesh: losing ANY device of the mesh is a whole-mesh loss (GSPMD
+        # collectives span every chip), so unhealthy ⇒ the mesh is dropped
+        # and degraded waves run single-device on the CPU fallback;
+        # re-admission reforms the mesh — narrower unless a full-width
+        # probe passes — and the next snapshot re-shards from host staging
+        self.mesh_state = mesh_state
         self.stats = SupervisorStats()
         self._mu = threading.Lock()
         self._healthy = True
@@ -209,10 +217,21 @@ class DispatchSupervisor:
             return None
         return self._fallback_dev()
 
+    def snapshot_mesh(self):
+        """Mesh placement for cache snapshots: the live mesh while healthy,
+        None while degraded (degraded waves are single-device on the CPU
+        fallback — a collective over a mesh containing a dead chip would
+        hang every healthy one too)."""
+        if not self._healthy or self.mesh_state is None:
+            return None
+        return self.mesh_state.mesh
+
     def note_cycle_signature(self, dims, engine: str, extras: tuple,
                              gang: bool) -> None:
         """Remember what the live cycle program looks like so re-admission
-        can warm exactly it."""
+        can warm exactly it (the mesh itself is NOT part of the note: the
+        rewarm targets whatever mesh exists post-reform, never the dead
+        one's signature)."""
         self._cycle_sig = (dims, engine, extras, gang)
 
     def _mark_unhealthy(self, reason: str) -> None:
@@ -222,6 +241,13 @@ class DispatchSupervisor:
                 return
             self._healthy = False
             self.stats.unhealthy_since = self.clock()
+            # a mesh containing the lost device is wholly untrusted: drop
+            # it NOW so snapshot_mesh() routes degraded waves single-device
+            if self.mesh_state is not None:
+                try:
+                    self.mesh_state.on_backend_loss()
+                except Exception:  # noqa: BLE001 - health flip must not die
+                    pass
             # executables compiled against the lost backend may be dead —
             # drop them; the rewarm on re-admission repopulates
             if self.prewarmer is not None:
@@ -282,6 +308,48 @@ class DispatchSupervisor:
         done.wait(float(os.environ.get("KTPU_PROBE_DEADLINE", "10")))
         return ok[0]
 
+    def _probe_mesh_full(self) -> bool:
+        """Can the mesh come back at FULL width? One tiny collective over
+        every device the full mesh would use — a chip that initializes but
+        cannot join a psum must keep the mesh narrow. The `mesh.degrade`
+        chaos seam forces the narrow path in drills. The collective runs on
+        its own worker under the probe deadline — a chip that re-inits but
+        WEDGES mid-collective must cost one abandoned thread, not a prober
+        blocked forever (same contract as _probe_once)."""
+        if faultline.should("mesh.degrade", "probe"):
+            return False
+        done = threading.Event()
+        ok = [False]
+
+        def probe() -> None:
+            try:
+                import jax
+                import jax.numpy as jnp
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                from ..parallel.mesh import NODE_AXIS, make_mesh
+
+                want = self.mesh_state._requested or len(jax.devices())
+                if want <= 1:
+                    return
+                m = make_mesh(1 << (max(want, 1).bit_length() - 1))
+                n = len(m.devices.flat)
+                x = jax.device_put(jnp.arange(n, dtype=jnp.int32),
+                                   NamedSharding(m,
+                                                 PartitionSpec(NODE_AXIS)))
+                total = int(jax.jit(lambda a: a.sum())(x))
+                ok[0] = total == n * (n - 1) // 2
+            except Exception:  # noqa: BLE001 - probe failure = stay narrow
+                pass
+            finally:
+                done.set()
+
+        t = threading.Thread(target=probe, name="ktpu-mesh-full-probe",
+                             daemon=True)
+        t.start()
+        done.wait(float(os.environ.get("KTPU_PROBE_DEADLINE", "10")))
+        return ok[0]
+
     def _readmit(self) -> None:
         with self._mu:
             if self._healthy:
@@ -293,11 +361,22 @@ class DispatchSupervisor:
                     self.clock() - self.stats.unhealthy_since, 3)
             self.stats.unhealthy_since = None
             sig = self._cycle_sig
+        mesh = None
+        if self.mesh_state is not None:
+            # reform the mesh from the devices that are live NOW: full
+            # width when a whole-mesh collective proves every chip answers,
+            # else narrower (losing one device of an 8-way mesh serves on
+            # 4). Either way the Mesh OBJECT is fresh, which forces
+            # state/cache.py to re-shard resident state from host staging.
+            try:
+                mesh = self.mesh_state.reform(full=self._probe_mesh_full())
+            except Exception:  # noqa: BLE001 - single-device serving is
+                mesh = None    # always a legal landing spot
         if self.prewarmer is not None and sig is not None:
             dims, engine, extras, gang = sig
             try:
                 if self.prewarmer.rewarm(dims, engine=engine, extras=extras,
-                                         gang=gang):
+                                         gang=gang, mesh=mesh):
                     self.stats.rewarms += 1
             except Exception:  # noqa: BLE001 - rewarm is an optimization
                 pass

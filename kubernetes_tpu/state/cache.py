@@ -31,6 +31,8 @@ lattice — spreading is handled by the PodTopologySpread scores natively
 
 from __future__ import annotations
 
+import functools
+import os
 import threading
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -56,6 +58,44 @@ def _patch_rows(tree, idx, rows):
     indices `idx` — the device half of the incremental snapshot
     (cache.go:204-255's per-NodeInfo copy, as one fused dynamic-update)."""
     return jax.tree.map(lambda a, r: a.at[idx].set(r), tree, rows)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _patch_rows_donated(tree, idx, rows):
+    """The mesh-resident variant: the input buffers are DONATED, so XLA
+    updates the resident sharded arrays in place (aliased output) instead of
+    allocating a second copy of the whole node plane per cycle. Only callable
+    when no in-flight dispatch still holds `tree` at the Python level — the
+    cache's `_dispatch_inflight` gate (see `_patch_snapshot`)."""
+    return jax.tree.map(lambda a, r: a.at[idx].set(r), tree, rows)
+
+
+class ResidentDonationError(RuntimeError):
+    """A donated mesh-resident patch silently COPIED instead of aliasing (the
+    donated input buffer survived). On a real chip that means the resident-
+    state design is paying 2× HBM and a full-plane copy per cycle without
+    anyone noticing — fail loudly (ISSUE 3 acceptance: the donation assert
+    proves the steady-state path never re-uploads the snapshot)."""
+
+
+def _patch_resident(tree, idx, rows, donate: bool, cache=None):
+    """One resident-buffer scatter. `donate=True` asserts the old buffers
+    were actually consumed; set KTPU_MESH_DONATION_STRICT=0 to count-and-
+    continue (cache.resident_donation_failures) on platforms whose runtime
+    cannot alias (none of ours — CPU, GPU and TPU all donate)."""
+    if not donate:
+        return _patch_rows(tree, idx, rows)
+    out = _patch_rows_donated(tree, idx, rows)
+    leaves = [a for a in jax.tree.leaves(tree)]
+    if leaves and not all(a.is_deleted() for a in leaves):
+        if cache is not None:
+            cache.resident_donation_failures += 1
+        if os.environ.get("KTPU_MESH_DONATION_STRICT", "1") != "0":
+            raise ResidentDonationError(
+                "mesh-resident patch did not donate: "
+                f"{sum(not a.is_deleted() for a in leaves)}/{len(leaves)} "
+                "input buffers survived the scatter (silent full copy)")
+    return out
 
 
 def _pad_patch(idx: List[int], k_bucket: int) -> np.ndarray:
@@ -103,6 +143,11 @@ class Snapshot:
                            # default). The dispatch supervisor routes
                            # degraded-mode snapshots to the CPU fallback so
                            # no cycle ever touches a lost backend's buffers.
+    mesh: object = None  # jax.sharding.Mesh when the tables are resident
+                         # sharded across the device mesh (node axis split,
+                         # small tables replicated — parallel/mesh.py);
+                         # keyed by IDENTITY: a reformed mesh is a new
+                         # object, which forces re-shard from host staging.
 
 
 class SchedulerCache:
@@ -143,6 +188,27 @@ class SchedulerCache:
         # introspection for tests/bench: how the last snapshot was produced
         self.last_snapshot_mode: str = ""   # "cached" | "patch" | "full"
         self.last_patch_rows: int = 0
+        # ---- mesh-resident accounting (ISSUE 3 donation contract) ----
+        # full shard_tables uploads (cold / capacity growth / mesh reform)
+        self.resident_full_uploads: int = 0
+        # steady-state patches that DONATED the resident buffers (aliased
+        # in-place update — the proof there is no full-snapshot device_put)
+        self.resident_donated_patches: int = 0
+        # patches that had to copy because a dispatch still held the front
+        # buffer (the prestage half of the double-buffer — see
+        # mark_dispatch_start)
+        self.resident_copy_patches: int = 0
+        # >0 while a dispatch holds the current snapshot's arrays at the
+        # Python level: donating them would delete buffers a worker thread
+        # is about to hand to XLA. The scheduler brackets submit→result
+        # with mark_dispatch_start/done; prestage snapshots built inside
+        # that window take the copy path (the back buffer of the double
+        # buffer), and the next on-path snapshot donates the back buffer.
+        self._dispatch_inflight: int = 0
+        self._last_pending_patched = False
+        # donated patches whose input buffers survived (silent copy) — only
+        # grows in non-strict mode; strict mode raises instead
+        self.resident_donation_failures: int = 0
         # gang groups: bound/assumed member count per group key (ops/gang.py
         # nets snapshot `needed` against these — minMember already satisfied
         # by running members doesn't have to re-place)
@@ -346,6 +412,18 @@ class SchedulerCache:
             assumed = sum(1 for s in self._pods.values() if s.assumed)
             return len(self._nodes), len(self._pods), assumed
 
+    def mark_dispatch_start(self) -> None:
+        """A dispatch now holds the current snapshot's device arrays (the
+        scheduler calls this right before handing them to the watchdog
+        worker). While in flight, mesh-resident patches must not donate —
+        they take the copy path into the back buffer instead."""
+        with self._mu:
+            self._dispatch_inflight += 1
+
+    def mark_dispatch_done(self) -> None:
+        with self._mu:
+            self._dispatch_inflight = max(self._dispatch_inflight - 1, 0)
+
     def snapshot(
         self,
         encoder: Encoder,
@@ -353,6 +431,7 @@ class SchedulerCache:
         base_dims: Optional[Dims] = None,
         extra_intern: Sequence[str] = (),
         device: object = None,
+        mesh: object = None,
     ) -> Snapshot:
         """UpdateNodeInfoSnapshot analog (cache.go:204-255): return the cached
         encoded view when nothing changed; re-encode ONLY the dirty node/pod
@@ -370,7 +449,7 @@ class SchedulerCache:
             snap = self._snapshot
             if snap is not None and snap.generation == gen \
                     and snap.pending_keys == pending_keys \
-                    and snap.device == device:
+                    and snap.device == device and snap.mesh is mesh:
                 self.last_snapshot_mode = "cached"
                 return snap
 
@@ -475,6 +554,17 @@ class SchedulerCache:
             # the engine-routing flag is per-batch, not a capacity: it must
             # not force a full re-encode when it flips
             d = replace(d, has_node_name=any(p.node_name for p in pending))
+            if mesh is not None:
+                # the node axis must divide the mesh evenly so each chip
+                # owns N/n_devices rows; pad the CAPACITY (extra slots are
+                # inert exactly like any unoccupied bucket slot) rather
+                # than padding arrays post-hoc, so staging and resident
+                # shapes agree and the patch scatter stays shape-stable
+                from ..parallel.mesh import padded_node_count
+
+                nd = len(mesh.devices.flat)
+                if d.N % nd:
+                    d = replace(d, N=padded_node_count(d.N, nd))
 
             full = (
                 snap is None
@@ -487,15 +577,19 @@ class SchedulerCache:
                 # path's scatter-into-resident is unusable; rebuild from
                 # the host staging, which never left the host
                 or snap.device != device
+                # mesh change (first shard, reform after device loss, or
+                # drop to single-device): resident buffers carry the OLD
+                # sharding — re-shard from host staging
+                or snap.mesh is not mesh
                 or replace(d, has_node_name=False)
                 != replace(snap.dims, has_node_name=False)
             )
             if full:
                 return self._full_snapshot(encoder, pending, pending_keys,
-                                           gen, d, base_dims, device)
+                                           gen, d, base_dims, device, mesh)
             return self._patch_snapshot(encoder, pending, pending_keys,
                                         gen, d, snap, released_nodes,
-                                        device)
+                                        device, mesh)
 
     @staticmethod
     def _registry_sizes(encoder: Encoder) -> Dict[str, int]:
@@ -511,13 +605,17 @@ class SchedulerCache:
             "volsets": len(encoder.volset_reg),
         }
 
-    def _gang_arrays(self, encoder: Encoder, pending, d: Dims):
+    def _gang_arrays(self, encoder: Encoder, pending, d: Dims,
+                     mesh: object = None):
         """Per-cycle GangArrays for the pending batch, netting each group's
         `needed` against members already bound/assumed in this cache."""
         bound = {encoder.pod_groups.get(gk): c
                  for gk, c in self._group_bound.items()
                  if encoder.pod_groups.get(gk) >= 0}
-        return encoder.build_gang_arrays(list(pending), d, bound)
+        g = encoder.build_gang_arrays(list(pending), d, bound)
+        if g is not None and mesh is not None:
+            g = self._put(g, None, mesh)  # replicate: read by every shard
+        return g
 
     def _existing_pod_arrays(self, d: Dims) -> PodArrays:
         rows = self._staging_pod_rows
@@ -529,9 +627,25 @@ class SchedulerCache:
             node_name_req=rows[: d.E, 5],
         )
 
+    @staticmethod
+    def _replicated(mesh):
+        """NamedSharding for the replicated leaves (pending/existing/indices)
+        of a mesh-resident snapshot."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(mesh, PartitionSpec())
+
+    def _put(self, tree, device, mesh):
+        """Route host arrays to their serving placement: replicated across
+        the mesh when one is active, else onto `device` (None = default)."""
+        if mesh is not None:
+            return jax.device_put(tree, self._replicated(mesh))
+        return jax.device_put(tree, device)
+
     def _full_snapshot(self, encoder, pending, pending_keys, gen, d,
                        base_dims: Optional[Dims] = None,
-                       device: object = None) -> Snapshot:
+                       device: object = None,
+                       mesh: object = None) -> Snapshot:
         """Cold path: rebuild staging + every device table. Runs when
         capacities grow (recompile territory anyway) or on first use."""
         self.last_snapshot_mode = "full"
@@ -600,17 +714,30 @@ class SchedulerCache:
         )
         pe = encoder.build_pod_arrays(list(pending), d, self._node_slot,
                                       capacity=d.P)
+        if mesh is not None:
+            # mesh-resident placement: node axis split across the mesh's
+            # chips, small interned tables replicated (parallel/mesh.py);
+            # pending/existing replicate — they are read by every chip's
+            # shard of the lattice. This is the ONE full upload; steady
+            # state patches the resident shards (see _patch_snapshot).
+            from ..parallel.mesh import shard_tables
+
+            tables_dev = shard_tables(tables, mesh)
+            self.resident_full_uploads += 1
+        else:
+            tables_dev = jax.device_put(tables, device)
         snap = Snapshot(
             generation=gen,
             node_order=list(self._node_names),
-            tables=jax.device_put(tables, device),
-            existing=jax.device_put(self._existing_pod_arrays(d), device),
-            pending=jax.device_put(pe, device),
+            tables=tables_dev,
+            existing=self._put(self._existing_pod_arrays(d), device, mesh),
+            pending=self._put(pe, device, mesh),
             dims=d,
             pending_keys=pending_keys,
             existing_keys=tuple(self._pod_keys),
-            gang=self._gang_arrays(encoder, pending, d),
+            gang=self._gang_arrays(encoder, pending, d, mesh),
             device=device,
+            mesh=mesh,
         )
         self._encoder = encoder
         self._reg_sizes = self._registry_sizes(encoder)
@@ -629,12 +756,26 @@ class SchedulerCache:
     def _patch_snapshot(self, encoder, pending, pending_keys, gen, d,
                         snap: Snapshot,
                         released_nodes: Sequence[int] = (),
-                        device: object = None) -> Snapshot:
+                        device: object = None,
+                        mesh: object = None) -> Snapshot:
         """Steady-state path: O(changed) host work, O(changed) device scatter.
         This is what makes `state/encode.py`'s "patched incrementally" promise
-        true — no full re-encode, no full re-upload."""
+        true — no full re-encode, no full re-upload.
+
+        Mesh-resident mode adds the donation/double-buffer contract: when no
+        dispatch holds the resident buffers (the usual on-path snapshot), the
+        scatter DONATES them — XLA aliases the update in place, and
+        `_patch_resident` raises if the runtime silently copied. When a
+        dispatch IS in flight (the scheduler's prestage snapshot, built while
+        the device still evaluates cycle N), the scatter copies into a back
+        buffer instead — that copy is what lets cycle N+1's delta upload
+        overlap cycle N's dispatch, and the NEXT on-path patch donates the
+        back buffer."""
         self.last_snapshot_mode = "patch"
         from .dims import bucket
+
+        donate = mesh is not None and self._dispatch_inflight == 0
+        patched_resident = False
 
         # --- new topology keys: backfill only the new [N] topo column(s) ---
         # A never-seen topologyKey used to force the ~full-encode fallback
@@ -678,15 +819,21 @@ class SchedulerCache:
 
         tables = snap.tables
         if topo_grew:
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                from ..parallel.mesh import NODE_AXIS
+
+                node_sh = NamedSharding(mesh, PartitionSpec(NODE_AXIS))
+                put_topo = lambda a: jax.device_put(
+                    np.ascontiguousarray(a), node_sh)
+            else:
+                put_topo = lambda a: jax.device_put(
+                    np.ascontiguousarray(a), device)
             tables = tables._replace(
                 nodes=tables.nodes._replace(
-                    topo=jax.device_put(
-                        np.ascontiguousarray(self._staging_nodes.topo),
-                        device),
-                    domain=jax.device_put(
-                        np.ascontiguousarray(self._staging_nodes.domain),
-                        device)),
-                zone_keys=jax.device_put(encoder.build_zone_keys(), device))
+                    topo=put_topo(self._staging_nodes.topo),
+                    domain=put_topo(self._staging_nodes.domain)),
+                zone_keys=self._put(encoder.build_zone_keys(), device, mesh))
         if node_idx:
             kb = bucket(len(node_idx))
             idx = _pad_patch(node_idx, kb)
@@ -696,8 +843,12 @@ class SchedulerCache:
             # jnp.asarray would materialize on the default (possibly lost)
             # backend even when the rest of the patch targets the fallback
             tables = tables._replace(
-                nodes=_patch_rows(tables.nodes,
-                                  jax.device_put(idx, device), rows))
+                nodes=_patch_resident(tables.nodes,
+                                      self._put(idx, device, mesh),
+                                      self._put(rows, device, mesh)
+                                      if mesh is not None else rows,
+                                      donate, self))
+            patched_resident = True
 
         # --- small interned tables: rebuild only the ones whose registry grew
         sizes = self._registry_sizes(encoder)
@@ -714,7 +865,7 @@ class SchedulerCache:
                 "volsets": encoder.build_volset_table,
             }
             tables = tables._replace(**{
-                k: jax.device_put(builders[k](d), device)
+                k: self._put(builders[k](d), device, mesh)
                 for k in builders if sizes[k] != self._reg_sizes[k]
             })
             self._reg_sizes = sizes
@@ -759,8 +910,11 @@ class SchedulerCache:
             idx = _pad_patch(pod_idx, kb)
             host = self._existing_pod_arrays(d)
             rows = PodArrays(*[np.ascontiguousarray(f[idx]) for f in host])
-            existing = _patch_rows(existing, jax.device_put(idx, device),
-                                   rows)
+            existing = _patch_resident(
+                existing, self._put(idx, device, mesh),
+                self._put(rows, device, mesh) if mesh is not None else rows,
+                donate, self)
+            patched_resident = True
 
         # --- pending: identity-diffed against the previous batch ---
         # The unschedulable/backoff queues feed largely the SAME pod
@@ -772,9 +926,16 @@ class SchedulerCache:
         if pending_keys == snap.pending_keys:
             pe = snap.pending
         else:
+            self._last_pending_patched = False
             pe = self._pending_block(encoder, pending, pending_keys, d,
-                                     snap.pending, device)
+                                     snap.pending, device, mesh, donate)
+            patched_resident = patched_resident or self._last_pending_patched
 
+        if mesh is not None and patched_resident:
+            if donate:
+                self.resident_donated_patches += 1
+            else:
+                self.resident_copy_patches += 1
         new_snap = Snapshot(
             generation=gen,
             node_order=list(self._node_names),
@@ -784,8 +945,9 @@ class SchedulerCache:
             dims=d,
             pending_keys=pending_keys,
             existing_keys=tuple(self._pod_keys),
-            gang=self._gang_arrays(encoder, pending, d),
+            gang=self._gang_arrays(encoder, pending, d, mesh),
             device=device,
+            mesh=mesh,
         )
         self._dirty_nodes.clear()
         self._dirty_pods.clear()
@@ -795,7 +957,8 @@ class SchedulerCache:
 
 
     def _pending_block(self, encoder, pending, pending_keys, d: Dims,
-                       prev_device, device: object = None):
+                       prev_device, device: object = None,
+                       mesh: object = None, donate: bool = False):
         """Pending PodArrays, identity-diffed against the previous batch:
         when the batch largely repeats, only the changed slots re-derive on
         the persistent host stage and SCATTER into the resident device
@@ -837,13 +1000,16 @@ class SchedulerCache:
                     node_id=stage.node_id[idx],
                     node_name_req=np.ascontiguousarray(stage.rows[idx, 5]),
                 )
-                return _patch_rows(prev_device,
-                                   jax.device_put(idx, device), rows)
+                self._last_pending_patched = True
+                return _patch_resident(
+                    prev_device, self._put(idx, device, mesh),
+                    self._put(rows, device, mesh) if mesh is not None
+                    else rows, donate, self)
         pe_host = encoder.build_pod_arrays(
             list(pending), d, self._node_slot, capacity=d.P)
         self._pending_stage = _PendingStage.from_pod_arrays(pe_host)
         self._pending_stage_keys = pending_keys
-        return jax.device_put(pe_host, device)
+        return self._put(pe_host, device, mesh)
 
 
 class _PendingStage:

@@ -83,7 +83,7 @@ class TestTriggerPolicy:
         calls = []
         ev = threading.Event()
 
-        def fake_compile(d, engine, extras, gang):
+        def fake_compile(d, engine, extras, gang, mesh=None):
             calls.append((d, engine, gang))
             ev.set()
         return calls, ev, fake_compile
@@ -181,7 +181,7 @@ class TestGrowthAcrossBucketBoundary:
         s = Scheduler(binder=binder, base_dims=Dims().grown_for(N=16, E=16))
         s.prewarmer = BucketPrewarmer(
             threshold=0.8, min_axis=8,
-            compile_fn=lambda d, e, x, g: calls.append(d))
+            compile_fn=lambda d, e, x, g, m=None: calls.append(d))
 
         for i in range(8):
             s.on_node_add(mknode(i))
@@ -205,3 +205,147 @@ class TestGrowthAcrossBucketBoundary:
         assert calls, "prewarmer never fired while growing to the boundary"
         assert any(d.N > 16 for d in calls)
         assert len(binder.bound) == pod_i
+
+
+class TestMeshSignatureIsolation:
+    """ISSUE 3 satellite: executables are keyed on (bucket, mesh signature),
+    so single-device and mesh programs never cross-pollinate — after a
+    device loss → CPU fallback → re-admission cycle, no mesh-shaped
+    executable can ever be handed single-device arrays (a silent reshard
+    onto possibly-dead devices) and vice versa."""
+
+    def _mesh(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 (virtual) devices")
+        from kubernetes_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(8)
+
+    def test_mesh_and_single_device_warm_separate_keys(self):
+        mesh = self._mesh()
+        calls = []
+        pw = BucketPrewarmer(
+            threshold=0.8, min_axis=8,
+            compile_fn=lambda d, e, x, g, m=None: calls.append((d, m)))
+        d = Dims().grown_for(N=16, E=16)
+        pw.observe(d, n_nodes=14, n_existing=1)              # single-device
+        pw.wait(5)
+        pw.observe(d, n_nodes=14, n_existing=1, mesh=mesh)   # mesh
+        pw.wait(5)
+        assert len(calls) == 2
+        assert calls[0][1] is None and calls[1][1] is mesh
+
+    def test_lookup_isolation_across_mesh_signatures(self):
+        """A Compiled stored under the mesh key must be invisible to a
+        single-device lookup at identical dims (and vice versa)."""
+        mesh = self._mesh()
+        pw = BucketPrewarmer(threshold=0.8, min_axis=8)
+        d = Dims().grown_for(N=16, E=16)
+        from dataclasses import replace
+
+        from kubernetes_tpu.parallel.mesh import mesh_key
+
+        base = replace(d, has_node_name=False)
+        pw.compiled[(base, "waves", (), False, mesh_key(mesh))] = "MESH-EXE"
+        pw.compiled[(base, "waves", (), False, None)] = "SINGLE-EXE"
+        assert pw.lookup(d, "waves", (), False, mesh=mesh) == "MESH-EXE"
+        assert pw.lookup(d, "waves", (), False, mesh=None) == "SINGLE-EXE"
+        # preempt programs carry the same isolation
+        pw.compiled[pw._preempt_key(d, 8, mesh)] = "MESH-PREEMPT"
+        assert pw.lookup_preempt(d, 8, mesh=None) is None
+        assert pw.lookup_preempt(d, 8, mesh=mesh) == "MESH-PREEMPT"
+
+    def test_mesh_abstract_args_carry_shardings(self):
+        """abstract_cycle_args(mesh=...) must annotate the node tables with
+        the node-axis sharding and everything else replicated — the AOT
+        compile then produces the GSPMD executable the live path needs."""
+        mesh = self._mesh()
+        d = Dims().grown_for(N=16, P=16, E=16)
+        tables, pending, keys, existing, hw, ecfg, _ = abstract_cycle_args(
+            d, mesh=mesh)
+        assert tables.nodes.alloc.sharding.spec == ("nodes",)
+        assert tables.classes.rid.sharding.is_fully_replicated
+        assert pending.cls.sharding.is_fully_replicated
+
+    def test_mesh_abstract_args_compile_through_production_jit(self):
+        """The sharded abstract pytree must AOT-compile through the
+        production jit — the executable the rewarm path stores for the
+        first post-recovery mesh wave."""
+        mesh = self._mesh()
+        from kubernetes_tpu.sched.cycle import _schedule_batch_impl
+
+        d = Dims().grown_for(N=16, P=16, E=16)
+        (tables, pending, keys, existing, hw, ecfg,
+         gang) = abstract_cycle_args(d, mesh=mesh)
+        compiled = _schedule_batch_impl.lower(
+            tables, pending, keys, d.D, existing, "waves", hw, ecfg,
+            (), (), gang).compile()
+        assert compiled is not None
+
+    @pytest.mark.chaos
+    def test_loss_fallback_readmission_never_crosses_signatures(self):
+        """The full drill: mesh serving → injected device error → degraded
+        single-device wave → prober re-admission → reformed mesh. At every
+        stage the prewarmer's stored executables must be keyed to the
+        placement the NEXT dispatch will actually use: the loss invalidates
+        everything (a mesh executable may be pinned to dead devices), and
+        the re-admission rewarm targets the REFORMED mesh signature, never
+        the dead one's."""
+        import os
+
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 (virtual) devices")
+        from kubernetes_tpu.parallel.mesh import mesh_key
+        from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+        from kubernetes_tpu.utils import faultline
+
+        os.environ["KTPU_PROBE_BACKOFF"] = "0.05"
+        faultline.install("device.error@cycle:2,mesh.degrade@probe:1")
+        try:
+            s = Scheduler(binder=RecordingBinder(), mesh=8, batch_size=4,
+                          base_dims=Dims().grown_for(N=16, P=4, E=64))
+            lookups = []
+            orig_lookup = s.prewarmer.lookup
+
+            def spy_lookup(d, engine, extras, gang, mesh=None):
+                lookups.append(mesh_key(mesh))
+                return orig_lookup(d, engine, extras, gang, mesh=mesh)
+
+            s.prewarmer.lookup = spy_lookup
+            for i in range(8):
+                s.on_node_add(mknode(i))
+            for i in range(16):
+                s.on_pod_add(Pod(name=f"p{i}",
+                                 requests=Resources.make(cpu="100m"),
+                                 creation_index=i))
+            mesh0 = s.mesh_state.mesh
+            assert mesh0 is not None
+            s.schedule_pending()          # wave 1: healthy, mesh0
+            s.schedule_pending()          # wave 2: injected loss → fallback
+            assert s.supervisor.stats.degraded_cycles >= 1
+            # the loss dropped the mesh AND every stored executable
+            assert s.mesh_state.mesh is None or s.mesh_state.mesh is not mesh0
+            assert not s.prewarmer.compiled
+            assert s.supervisor.wait_recovered(timeout=30)
+            mesh1 = s.mesh_state.mesh
+            assert mesh1 is not None and mesh1 is not mesh0
+            # the forced-degrade probe reformed NARROWER than the lost width
+            assert len(mesh1.devices.flat) < len(mesh0.devices.flat)
+            while s.queue.lengths()[0] > 0:
+                s.schedule_pending()      # post-recovery waves on mesh1
+            assert len(s.binder.bound) == 16
+            # every lookup the dispatch path made was keyed to the mesh of
+            # the snapshot it dispatched — degraded waves looked up the
+            # single-device (None) signature, never a mesh one
+            healthy_sigs = {None, mesh_key(mesh0), mesh_key(mesh1)}
+            assert set(lookups) <= healthy_sigs
+            # and nothing stored under the DEAD mesh's signature survives
+            assert all(k[-1] != mesh_key(mesh0)
+                       for k in s.prewarmer.compiled)
+        finally:
+            faultline.uninstall()
+            os.environ.pop("KTPU_PROBE_BACKOFF", None)
