@@ -77,6 +77,9 @@ DEFAULT_STAGES = [
     (5000, 100000, "gang"),
     (1000, 5000, "control"),  # scheduler-in-the-loop (not just the engine)
     (5000, 50000, "chaos"),  # device loss mid-run: degrade, recover, lose 0
+    (5000, 50000, "failover"),  # kill the LEADER mid-cycle: warm standby
+                                # takes over, replays the intent ledger,
+                                # zero lost / zero double-bound
     (2000, 16000, "growth"),
 ]
 
@@ -102,6 +105,9 @@ CYCLE_BUDGETS = {
     ("control", 1000): 90.0,     # r5 CPU ingest: 15-33 s
     ("chaos", 5000): 240.0,      # worst cycle = watchdog deadline + the
                                  # fallback's one-time cold CPU compile
+    ("failover", 5000): 30.0,    # cycle_seconds IS takeover_seconds here:
+                                 # leader killed mid-cycle → standby's
+                                 # first post-takeover bind lands
     ("growth", 2000): 60.0,      # boundary cycle ≤ cache-load, never compile
     # mesh cycle budget is the worst STEADY wave on the virtual CPU mesh
     # (8 host threads emulating ICI collectives — the real-silicon number
@@ -133,6 +139,15 @@ METRIC_BUDGETS = {
     # every steady-state cycle patches the resident shards with DONATED
     # buffers (the is_deleted assert ran and never tripped), and the run
     # loses nothing
+    # ISSUE 4 acceptance: killing the leader mid-cycle loses NOTHING — the
+    # standby's takeover replays the intent ledger (≥1 replayed proves the
+    # kill landed between intent and retire), no pod is double-bound, no
+    # pod is lost, and service resumes within the takeover budget
+    ("failover", 5000): {"takeover_seconds": ("<=", 30.0),
+                         "double_binds": ("<=", 0),
+                         "lost_pods": ("<=", 0),
+                         "replayed_intents": (">=", 1),
+                         "takeovers": (">=", 1)},
     ("mesh", 5000): {"bit_equal": (">=", 1),
                      "resident_full_uploads": ("<=", 1),
                      "donated_patches": (">=", 1),
@@ -191,8 +206,8 @@ def _run_stage(n_nodes, n_pods, kind, env, timeout):
     """Run one shape in a subprocess; returns a result dict (never raises)."""
     global _CURRENT_PROC
     env = dict(env)
-    if kind != "chaos":
-        # FAULT_SPEC is the chaos stage's contract alone: an operator
+    if kind not in ("chaos", "failover"):
+        # FAULT_SPEC is the fault-drill stages' contract alone: an operator
         # running the documented drill (FAULT_SPEC=... python bench.py)
         # must not have faults injected into the other stages' budgets
         env.pop("FAULT_SPEC", None)
@@ -536,6 +551,225 @@ def _chaos_stage(n_nodes, n_pods):
     }))
 
 
+def _failover_stage(n_nodes, n_pods):
+    """Leader kill → warm-standby takeover drill (docs/RESILIENCE.md
+    §Restart/HA): two full SchedulerServers (leader-elected, bind-intent
+    ledger over one apiserver) serve an n_pods storm across n_nodes; a
+    `proc.crash@post_bind` chaos kill takes the LEADER down mid-cycle —
+    Bindings committed, intent NOT retired, Lease NOT released (the
+    SIGKILL shape). The standby must wait out the lease, reconcile the
+    orphaned intent against informer truth, and resume binding. Emits
+    `takeover_seconds` (kill → first standby-committed bind),
+    `replayed_intents`, `double_binds`, `lost_pods` — METRIC_BUDGETS
+    enforces 0/0 and the 30 s takeover ceiling."""
+    import threading
+
+    import jax
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import Client
+    from kubernetes_tpu.sched.ledger import BindIntentLedger
+    from kubernetes_tpu.sched.server import SchedulerServer
+    from kubernetes_tpu.state.dims import Dims, bucket
+    from kubernetes_tpu.utils import faultline
+
+    api = APIServer()
+    client_a = Client.local(api)
+    client_b = Client.local(api)
+    watch_client = Client.local(api)
+    caps = {"capacity": {"cpu": "16", "memory": "64Gi", "pods": "110"},
+            "allocatable": {"cpu": "16", "memory": "64Gi", "pods": "110"}}
+    for i in range(n_nodes):
+        client_a.nodes.create({"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": f"n{i}"},
+                               "status": caps})
+    base = Dims(N=bucket(n_nodes), P=bucket(min(n_pods, 8192)),
+                E=bucket(n_pods + 256))
+    # short lease: takeover time is dominated by lease expiry + reconcile +
+    # first wave; production would run 15 s/10 s/2 s and budget accordingly
+    lease_cfg = dict(lease_duration=3.0, renew_deadline=2.0,
+                     retry_period=0.25)
+
+    def mk(ident, cl):
+        return SchedulerServer(
+            cl, leader_elect=True, cycle_interval=0.02, batch_window=0.15,
+            base_dims=base,
+            ledger=BindIntentLedger(api.storage, identity=ident),
+            lease_config=dict(identity=ident, **lease_cfg),
+            standby_warm_interval=1.0)
+
+    a = mk("a", client_a).start()
+    if not a.elector.wait_for_leadership(60):
+        print(json.dumps({"nodes": n_nodes, "pods": n_pods,
+                          "kind": "failover",
+                          "error": "initial leader never acquired"}))
+        api.close()
+        return
+    b = mk("b", client_b).start()  # the warm standby
+
+    # one watch stream observes every Binding (the double-bind detector:
+    # a pod whose committed nodeName ever CHANGES was bound twice)
+    bound_to = {}
+    double = [0]
+    lock = threading.Lock()
+    pump_stop = threading.Event()
+    watch = watch_client.pods.watch("default")
+
+    def pump():
+        while not pump_stop.is_set():
+            ev = watch.next(timeout=2)
+            if ev is None:
+                continue
+            obj = ev.object or {}
+            node = (obj.get("spec", {}) or {}).get("nodeName")
+            name = obj.get("metadata", {}).get("name", "")
+            if node and name:
+                with lock:
+                    prev = bound_to.get(name)
+                    if prev is not None and prev != node:
+                        double[0] += 1
+                    bound_to[name] = node
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def bound_count():
+        with lock:
+            return len(bound_to)
+
+    t_run0 = time.perf_counter()
+    try:
+        # warmup canary: pays the engine compile at the pinned base_dims
+        # OUTSIDE the measured drill (the control stage's pattern); the
+        # standby's warm_standby compiles its own copy concurrently
+        client_a.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "warmup", "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "c", "image": "i",
+                "resources": {"requests": {"cpu": "20m",
+                                           "memory": "16Mi"}}}]}})
+        deadline = time.perf_counter() + 600
+        while time.perf_counter() < deadline and bound_count() < 1:
+            time.sleep(0.1)
+        if bound_count() < 1:
+            print(json.dumps({"nodes": n_nodes, "pods": n_pods,
+                              "kind": "failover",
+                              "error": "warmup pod never bound"}))
+            return
+
+        # the kill: the leader dies on a mid-run intent RETIREMENT — after
+        # that wave's Bindings committed, before the intent record is
+        # retired (the nastiest row of the restart matrix); the warmup
+        # wave consumed retirement #1. Scale-aware: a small smoke shape
+        # drains in a couple of waves, so the kill must come early there
+        # or it never fires and the drill proves nothing
+        kill_retire = 6 if n_pods >= 5000 else 2
+        faultline.install(os.environ.get("FAULT_SPEC")
+                          or f"proc.crash@post_bind:{kill_retire}")
+
+        t_create0 = time.perf_counter()
+        for i in range(n_pods):
+            client_a.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"f-{i}", "namespace": "default"},
+                "spec": {"containers": [{
+                    "name": "c", "image": "i",
+                    "resources": {"requests": {"cpu": "20m",
+                                               "memory": "16Mi"}}}]}})
+        t_create = time.perf_counter() - t_create0
+
+        # wait for the crash to land (A's loop thread dies mid-cycle)
+        deadline = time.perf_counter() + 600
+        while time.perf_counter() < deadline \
+                and faultline.active().fired("proc.crash") == 0 \
+                and bound_count() < n_pods + 1:
+            time.sleep(0.1)
+        crash_fired = faultline.active().fired("proc.crash")
+        faultline.uninstall()
+        bound_at_kill = bound_count()
+        unretired_at_kill = len(BindIntentLedger(api.storage).unretired())
+        t_kill = time.perf_counter()
+        a.crash()  # lease unreleased, informers dead, nothing flushed
+
+        # takeover: B waits out the lease, reconciles, resumes binding.
+        # The "first new bind" baseline is sampled at B's lease
+        # ACQUISITION, not at the kill: the dead leader's last committed
+        # Bindings can still be draining through the watch stream right
+        # after t_kill, and counting one of those as takeover progress
+        # would measure watch latency, not service restoration. B cannot
+        # commit anything before it holds the lease, so every increase
+        # past this baseline is standby work.
+        took_over = b.elector.wait_for_leadership(120)
+        bound_at_acquire = bound_count()
+        first_new = None
+        deadline = time.perf_counter() + 900
+        while time.perf_counter() < deadline and bound_count() < n_pods + 1:
+            if first_new is None and bound_count() > bound_at_acquire:
+                first_new = time.perf_counter()
+            time.sleep(0.1)
+        if first_new is None and bound_count() > bound_at_acquire:
+            first_new = time.perf_counter()
+        # takeover_seconds is NEVER null in an ok record: null would both
+        # crash the driver's cycle-budget comparison and slip through the
+        # None-skipping metric-budget check — masking a stuck takeover,
+        # the one regression this stage exists to catch. No pods left at
+        # acquisition → 0.0 (service was never interrupted from the
+        # consumer's view); pods left and no standby bind → the full wait
+        # elapsed, which honestly breaches the 30 s ceiling.
+        if first_new is not None:
+            takeover_s = first_new - t_kill
+        elif bound_count() >= n_pods + 1:
+            takeover_s = 0.0
+        else:
+            takeover_s = time.perf_counter() - t_kill
+        t_total = time.perf_counter() - t_run0
+
+        # let the reconciliation counters settle before reading them
+        deadline = time.perf_counter() + 15
+        while time.perf_counter() < deadline and b.takeovers == 0:
+            time.sleep(0.1)
+        report = b.last_recovery
+        lost = (n_pods + 1) - bound_count()
+        stale_rejects = 0
+        for srv in (a, b):
+            stale_rejects += getattr(srv.scheduler.binder,
+                                     "stale_rejects", 0)
+        print(json.dumps({
+            "nodes": n_nodes, "pods": n_pods, "kind": "failover",
+            "scheduled": bound_count(), "failed": lost,
+            # the headline: service interruption from kill to the first
+            # standby-committed Binding (CYCLE_BUDGETS enforces ≤ 30 s)
+            "cycle_seconds": round(takeover_s, 3),
+            "takeover_seconds": round(takeover_s, 3),
+            "pods_per_sec": round(bound_count() / t_total, 1),
+            "create_seconds": round(t_create, 1),
+            "bound_at_kill": bound_at_kill,
+            "bound_at_acquire": bound_at_acquire,
+            "crash_fired": crash_fired,
+            "unretired_at_kill": unretired_at_kill,
+            "took_over": bool(took_over),
+            "takeovers": b.takeovers,
+            "replayed_intents": (report.replayed_intents if report else 0),
+            "recovered_already_bound": (report.already_bound
+                                        if report else 0),
+            "recovered_completed": (report.completed if report else 0),
+            "recovered_released": (report.released if report else 0),
+            "double_binds": double[0],
+            "lost_pods": lost,
+            "fenced_stale_binds": stale_rejects,
+            "unretired_final": len(BindIntentLedger(api.storage)
+                                   .unretired()),
+            "backend": jax.default_backend(),
+        }))
+    finally:
+        pump_stop.set()
+        faultline.uninstall()
+        if not a._crashed:
+            a.stop()
+        b.stop()
+        api.close()
+
+
 def _control_stage(n_nodes, n_pods):
     """Scheduler-IN-THE-LOOP throughput (VERDICT r4 weakness 6 / next-round
     item 8): the full control loop — watch-fed ingest through the informer,
@@ -575,8 +809,15 @@ def _control_stage(n_nodes, n_pods):
     # batch_window 0.15 s: an ingest STORM coalesces into few large waves
     # (each wave pays a snapshot patch + dispatch; per-pod latency floor
     # rises by the window, the throughput/latency knob a storm favors)
+    # The bind-intent ledger is ATTACHED: this stage is the steady-state
+    # control-loop number, and production serves with the write-ahead
+    # intent on the bind path — its per-wave CAS create+delete must be
+    # inside the measured (and budgeted) cycle, not benchmarked at zero
+    from kubernetes_tpu.sched.ledger import BindIntentLedger
+
     server = SchedulerServer(
         client, cycle_interval=0.02, batch_window=0.15,
+        ledger=BindIntentLedger(api.storage, identity="control"),
         base_dims=Dims(N=bucket(n_nodes), P=bucket(min(n_pods, 8192)),
                        E=bucket(n_pods + 256))).start()
 
@@ -712,6 +953,10 @@ def _control_stage(n_nodes, n_pods):
             "preempt_victims_evicted": evicted,
             "backoff_resolve_seconds": round(t_backoff, 3),
             "backoff_resolved": bool(resolved),
+            # intent-ledger accounting: every wave wrote+retired one record
+            # on the measured path; unretired must end 0
+            "intents_written": server.scheduler.ledger.intents_written,
+            "intents_unretired": len(server.scheduler.ledger.unretired()),
             "backend": jax.default_backend(),
         }))
     finally:
@@ -958,6 +1203,9 @@ def _stage_main(n_nodes, n_pods, kind):
     if kind == "chaos":
         _chaos_stage(n_nodes, n_pods)
         return
+    if kind == "failover":
+        _failover_stage(n_nodes, n_pods)
+        return
     if kind == "mesh":
         _mesh_stage(n_nodes, n_pods)
         return
@@ -1118,6 +1366,10 @@ def _compact_line(full, out_name, wrote):
             if r.get("kind") == "chaos":
                 e["degraded_cycles"] = r.get("degraded_cycles")
                 e["recovery_s"] = r.get("recovery_s")
+            if r.get("kind") == "failover":
+                e["takeover_s"] = r.get("takeover_seconds")
+                e["replayed"] = r.get("replayed_intents")
+                e["double_binds"] = r.get("double_binds")
             if r.get("kind") == "mesh":
                 e["bit_equal"] = r.get("bit_equal")
                 e["delta_up_s"] = r.get("delta_upload_seconds_mean")
@@ -1222,7 +1474,11 @@ def main():
         budget = CYCLE_BUDGETS.get((kind, n_nodes))
         if r.get("ok") and budget is not None:
             r["cycle_budget_seconds"] = budget
-            r["within_budget"] = r.get("cycle_seconds", 0.0) <= budget
+            # a null cycle time in an ok record is a stage bug, not a pass:
+            # flag it over-budget instead of crashing the whole run on a
+            # None comparison (the summary must always survive)
+            cs = r.get("cycle_seconds")
+            r["within_budget"] = cs is not None and cs <= budget
         r.setdefault("metric_breaches", []).extend(_check_metric_budgets(r))
         results.append(r)
         print(f"# stage {n_nodes}x{n_pods} {kind}: "
@@ -1253,8 +1509,8 @@ def _summarize(results, backend, probe_diags):
         f"{r.get('cycle_seconds')}s > {r.get('cycle_budget_seconds')}s"
         for r in results
         if isinstance(r, dict) and r.get("within_budget") is False
-        and r.get("cycle_seconds", 0.0) > r.get("cycle_budget_seconds",
-                                                float("inf"))]
+        and (r.get("cycle_seconds") or float("inf"))
+        > r.get("cycle_budget_seconds", float("inf"))]
     violations += [b for r in results if isinstance(r, dict)
                    for b in r.get("metric_breaches", ())]
     if violations:

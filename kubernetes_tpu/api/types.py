@@ -322,6 +322,19 @@ class HostPort:
 
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
+# Fencing annotations on Binding writes (exactly-once HA binding): the
+# scheduler stamps its lease generation (coordination.k8s.io Lease
+# `leaseTransitions` at acquire time) into every Binding; the apiserver
+# compares it against the live Lease and rejects a strictly older token —
+# a deposed leader that wakes up mid-write cannot land a stale bind.
+FENCING_TOKEN_ANNOTATION = "ktpu.io/fencing-token"
+FENCING_LEASE_ANNOTATION = "ktpu.io/fencing-lease"  # "namespace/name"
+DEFAULT_FENCING_LEASE = "kube-system/kube-scheduler"
+# machine-readable marker the apiserver embeds in a fenced-off 409's
+# message; clients detect fenced rejections by THIS token, not by prose
+# (survives the HTTP transport, which carries only code/reason/message)
+FENCED_BIND_MARKER = "FencedBind"
+
 
 @dataclass
 class Pod:

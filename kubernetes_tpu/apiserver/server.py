@@ -151,10 +151,18 @@ class APIServer:
 
     def bind_pod(self, namespace: str, name: str, binding: Obj) -> Obj:
         """POST pods/{name}/binding — the scheduler's terminal write
-        (registry/core/pod/storage/storage.go BindingREST.Create)."""
+        (registry/core/pod/storage/storage.go BindingREST.Create).
+
+        Fenced: a Binding stamped with a fencing token (the scheduler's
+        lease generation, api.types.FENCING_TOKEN_ANNOTATION) is checked
+        against the LIVE Lease; a strictly older token is a deposed
+        leader's write racing its own failover and is rejected with 409 —
+        the server-side half of exactly-once binding across leader
+        handoffs. Unstamped Bindings (non-HA schedulers, kubectl) pass."""
         target = (binding.get("target") or {}).get("name", "")
         if not target:
             raise errors.new_bad_request("binding.target.name is required")
+        self._check_bind_fence(binding, name)
         uid_pre = meta.uid(binding)
 
         def apply(pod: Obj) -> Obj:
@@ -175,6 +183,43 @@ class APIServer:
         return self.store("", "pods").storage.guaranteed_update(
             self.store("", "pods").key_for(namespace, name), apply,
             "pods", name)
+
+    def _check_bind_fence(self, binding: Obj, name: str) -> None:
+        """Reject a Binding whose fencing token is older than the current
+        lease generation. Token == current accepts (the live leader);
+        token > current accepts too (our Lease read can only lag the
+        truth — monotonicity means a NEWER token is never the stale
+        side). A missing Lease accepts: fencing is opt-in per write."""
+        from kubernetes_tpu.api.types import (DEFAULT_FENCING_LEASE,
+                                              FENCED_BIND_MARKER,
+                                              FENCING_LEASE_ANNOTATION,
+                                              FENCING_TOKEN_ANNOTATION)
+
+        ann = (binding.get("metadata") or {}).get("annotations") or {}
+        tok = ann.get(FENCING_TOKEN_ANNOTATION)
+        if tok is None:
+            return
+        lease_ref = ann.get(FENCING_LEASE_ANNOTATION, DEFAULT_FENCING_LEASE)
+        lns, _, lname = lease_ref.partition("/")
+        try:
+            lease = self.store("coordination.k8s.io", "leases").get(
+                lns, lname)
+        except errors.StatusError as e:
+            if errors.is_not_found(e):
+                return  # no lease on record → nothing to fence against
+            raise  # any OTHER failure must not silently open the fence
+        current = int((lease.get("spec") or {}).get("leaseTransitions", 0))
+        try:
+            stamped = int(tok)
+        except (TypeError, ValueError):
+            raise errors.new_bad_request(
+                f"malformed fencing token {tok!r}") from None
+        if stamped < current:
+            raise errors.new_conflict(
+                "pods", name,
+                f"{FENCED_BIND_MARKER}: fencing token {stamped} is stale "
+                f"(lease {lease_ref} is at generation {current}) — a "
+                f"deposed scheduler may not commit placements")
 
     def evict_pod(self, namespace: str, name: str, eviction: Obj) -> Obj:
         """POST pods/{name}/eviction — PDB-gated delete. The gate decrements

@@ -50,11 +50,27 @@ class LeaderElector:
         self._leading = threading.Event()
         self._observed_leader = ""
         self._thread: Optional[threading.Thread] = None
+        # fencing token: the Lease's leaseTransitions at OUR acquisition.
+        # Monotonic across holders (every holder change increments it), so
+        # stamping it into Binding/intent writes lets the apiserver fence
+        # off a deposed leader (apiserver/server.py bind_pod). Kept across
+        # loss on purpose: a stale incarnation keeps stamping its OLD token
+        # and gets rejected — that is the mechanism working.
+        self._fence_token = 0
+        # set by _try_acquire_or_renew when leadership is PROVABLY gone
+        # (another live holder observed, or our renew CAS conflicted): the
+        # renew loop must drop leadership immediately, not ride the
+        # retry-until-deadline window with a second fencing token live
+        self._deposed = False
+        # crash() sets this: the run loop's exit path must then skip both
+        # the release and the callbacks — a killed process runs neither
+        self._crashed = False
 
     # -- lease record ------------------------------------------------------- #
 
     def _try_acquire_or_renew(self) -> bool:
         leases = self.client.leases
+        was_leading = self._leading.is_set()
         now = time.time()
         try:
             lease = leases.get(self.cfg.lock_name, self.cfg.lock_namespace)
@@ -62,11 +78,13 @@ class LeaderElector:
             if not errors.is_not_found(e):
                 return False
             try:
-                leases.create({
+                created = leases.create({
                     "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
                     "metadata": {"name": self.cfg.lock_name,
                                  "namespace": self.cfg.lock_namespace},
                     "spec": self._record(now)})
+                self._fence_token = int(
+                    created.get("spec", {}).get("leaseTransitions", 0))
                 self._observe(self.cfg.identity)
                 return True
             except errors.StatusError:
@@ -82,18 +100,33 @@ class LeaderElector:
         if (holder and holder != self.cfg.identity
                 and renew + holder_duration > now):
             self._observe(holder)
+            if was_leading:
+                # we thought we led, the record says someone else does and
+                # their lease is LIVE: leadership is already lost — waiting
+                # out renew_deadline would keep two fencing tokens active
+                self._deposed = True
             return False  # someone else holds a live lease
         # claim/renew via CAS on resourceVersion
+        transitions = int(spec.get("leaseTransitions", 0)) \
+            + (0 if holder == self.cfg.identity else 1)
         lease["spec"] = self._record(
-            now, transitions=int(spec.get("leaseTransitions", 0))
-            + (0 if holder == self.cfg.identity else 1),
+            now, transitions=transitions,
             acquire=spec.get("acquireTime", now)
             if holder == self.cfg.identity else now)
         try:
             leases.update(lease, self.cfg.lock_namespace)
+            self._fence_token = transitions
             self._observe(self.cfg.identity)
             return True
-        except errors.StatusError:
+        except errors.StatusError as e:
+            if was_leading and errors.is_conflict(e):
+                # a CAS conflict while RENEWING means a concurrent writer
+                # touched our lease — the only writers are candidates who
+                # judged it expired (and may already have claimed it). The
+                # reference treats this as immediate loss; retrying until
+                # the deadline would leave a window where the usurper's
+                # fencing token and ours are both live.
+                self._deposed = True
             return False
 
     def _record(self, now: float, transitions: int = 0,
@@ -152,8 +185,11 @@ class LeaderElector:
             # (release lands, THIS thread's CAS then re-acquires the freshly
             # cleared lease, and the process exits holding it). Releasing on
             # loop exit closes that window; _release() no-ops unless the
-            # lease carries our identity.
-            self._release()
+            # lease carries our identity. A crash()ed elector releases
+            # NOTHING — a dead process cannot — so failover waits out the
+            # lease like real takeover does.
+            if not self._crashed:
+                self._release()
 
     def _run_loop(self) -> None:
         while not self._stop.is_set():
@@ -165,6 +201,7 @@ class LeaderElector:
                     return
             if self._stop.is_set():
                 return
+            self._deposed = False
             self._leading.set()
             self.cfg.on_started_leading()
             # renew phase
@@ -172,11 +209,16 @@ class LeaderElector:
             while not self._stop.is_set():
                 if self._try_acquire_or_renew():
                     deadline = time.monotonic() + self.cfg.renew_deadline
-                elif time.monotonic() > deadline:
-                    break  # failed to renew in time → lost leadership
+                elif self._deposed or time.monotonic() > deadline:
+                    # deposed: PROOF of loss (live usurper observed, or our
+                    # renew CAS conflicted) — drop leadership now instead
+                    # of serving out the deadline with a stale token live
+                    break
                 if self._stop.wait(self._jittered(self.cfg.retry_period)):
                     break
             self._leading.clear()
+            if self._crashed:
+                return  # a killed process runs no callbacks
             self.cfg.on_stopped_leading()
 
     def start(self) -> "LeaderElector":
@@ -203,9 +245,30 @@ class LeaderElector:
         if thread_done:
             self._release()
 
+    def crash(self) -> None:
+        """Simulated abrupt process death (restart drills, the bench
+        `failover` stage): the election thread stops WITHOUT releasing the
+        Lease and WITHOUT firing on_stopped_leading — exactly what SIGKILL
+        leaves behind. The next candidate must wait out lease_duration, and
+        this incarnation's fencing token goes stale the moment they claim."""
+        self._crashed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+        self._leading.clear()
+
     @property
     def is_leader(self) -> bool:
         return self._leading.is_set()
+
+    @property
+    def fencing_token(self) -> int:
+        """The lease generation (leaseTransitions) of this elector's most
+        recent acquisition — stamp it into every write that must not
+        survive a leadership change. Deliberately NOT gated on is_leader:
+        a deposed incarnation keeps its stale token so its in-flight
+        writes are rejected rather than silently unstamped."""
+        return self._fence_token
 
     def wait_for_leadership(self, timeout: float = 10.0) -> bool:
         return self._leading.wait(timeout)

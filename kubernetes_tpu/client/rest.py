@@ -276,12 +276,17 @@ class ResourceClient:
     # -- subresources ------------------------------------------------------- #
 
     def bind(self, name: str, node_name: str, namespace: str = "default",
-             uid: str = "") -> Obj:
+             uid: str = "", annotations: Optional[Dict[str, str]] = None
+             ) -> Obj:
         binding = {"apiVersion": "v1", "kind": "Binding",
                    "metadata": {"name": name, "namespace": namespace},
                    "target": {"kind": "Node", "name": node_name}}
         if uid:
             binding["metadata"]["uid"] = uid
+        if annotations:
+            # fencing-token stamping rides here (api.types
+            # FENCING_TOKEN_ANNOTATION); the server fences on it
+            binding["metadata"]["annotations"] = dict(annotations)
         return self.transport.request(
             "POST", self._path(namespace, name, "binding"), {}, binding)
 

@@ -10,7 +10,7 @@ cache-corruption Fatalf analog, cache.go:445,473)."""
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -25,27 +25,34 @@ class CacheComparer:
         self.cache = cache
         self.client = client
 
-    def compare_nodes(self) -> Tuple[List[str], List[str]]:
-        """(missing_from_cache, stale_in_cache) node names."""
-        if self.client is None:
+    def compare_nodes(self, items: "Optional[List]" = None
+                      ) -> Tuple[List[str], List[str]]:
+        """(missing_from_cache, stale_in_cache) node names. `items` lets a
+        caller that already listed the nodes (the periodic sweep, which
+        also needs them for healing) skip a second full LIST."""
+        if self.client is None and items is None:
             return [], []
         from ..machinery import meta
 
-        api_names = {meta.name(n)
-                     for n in self.client.nodes.list()["items"]}
+        if items is None:
+            items = self.client.nodes.list()["items"]
+        api_names = {meta.name(n) for n in items}
         cache_names = {n.name for n in self.cache.nodes()}
         return sorted(api_names - cache_names), sorted(cache_names - api_names)
 
-    def compare_pods(self) -> Tuple[List[str], List[str]]:
+    def compare_pods(self, items: "Optional[List]" = None
+                     ) -> Tuple[List[str], List[str]]:
         """(missing_from_cache, stale_in_cache) pod keys; assumed pods are
         legitimately cache-only and excluded from staleness (comparer.go
-        ComparePods ignores assumed)."""
-        if self.client is None:
+        ComparePods ignores assumed). `items` as in compare_nodes."""
+        if self.client is None and items is None:
             return [], []
         from ..machinery import meta
 
+        if items is None:
+            items = self.client.pods.list(None)["items"]
         api_keys = {f"{meta.namespace(p)}/{meta.name(p)}"
-                    for p in self.client.pods.list(None)["items"]
+                    for p in items
                     if p.get("spec", {}).get("nodeName")}
         cache_keys = {p.key for p in self.cache.scheduled_pods()}
         assumed = {p.key for p in self.cache.scheduled_pods()
@@ -86,6 +93,11 @@ class CacheComparer:
                 node = cache._nodes.get(name)
                 if node is None:
                     continue
+                if name in cache._dirty_nodes:
+                    # mutated since the last snapshot: staging is
+                    # LEGITIMATELY behind until the next patch re-encodes
+                    # this row — pending work, not drift
+                    continue
                 enc.encode_node_row(
                     fresh, slot, node,
                     list(cache._by_node.get(name, {}).values()), d)
@@ -95,6 +107,114 @@ class CacheComparer:
                     if not np.array_equal(a, b):
                         drift.append(f"node {name} field {fld}")
             return drift
+
+
+class ConsistencySweeper:
+    """Periodic cache-consistency sweep (the kube `cacheComparer` run on a
+    timer instead of SIGUSR2): diff the scheduler's resident view — cache
+    contents AND the incrementally-patched staging arrays behind the device
+    `ClusterTables` — against informer/apiserver truth; log divergence,
+    bump the consistency metrics, and SELF-HEAL: missing/stale objects are
+    reconciled from truth and the snapshot is invalidated so the next wave
+    re-encodes from scratch instead of trusting drifted patches.
+
+    Assumed pods are exempt from staleness (they are legitimately
+    cache-only until the Binding confirmation lands), exactly as the
+    reference's ComparePods. Call `maybe_sweep(now)` from the serving loop;
+    `sweep()` runs one pass unconditionally (the restart drill does)."""
+
+    def __init__(self, scheduler, client=None, interval: float = 60.0,
+                 log=print):
+        self.scheduler = scheduler
+        self.comparer = CacheComparer(scheduler.cache, client)
+        self.interval = interval
+        self.log = log
+        self._last = 0.0
+        # totals for tests/bench (the metrics registry keeps the gauges)
+        self.sweeps = 0
+        self.divergences = 0
+        self.heals = 0
+
+    def maybe_sweep(self, now: float) -> Optional[Dict[str, int]]:
+        if now - self._last < self.interval:
+            return None
+        self._last = now
+        return self.sweep()
+
+    def sweep(self) -> Dict[str, int]:
+        from .metrics import (CACHE_CONSISTENCY_DIVERGENCES,
+                              CACHE_CONSISTENCY_HEALS,
+                              CACHE_CONSISTENCY_SWEEPS)
+
+        self.sweeps += 1
+        CACHE_CONSISTENCY_SWEEPS.inc()
+        # ONE list per resource per sweep: the same snapshot feeds both the
+        # compare and (on divergence) the heal, so they can never disagree
+        # and the apiserver sees half the LIST load
+        # None (no client) must stay None: an EMPTY list would read as
+        # "the apiserver has no objects" and flag the whole cache stale
+        client = self.comparer.client
+        node_items = client.nodes.list()["items"] if client else None
+        pod_items = client.pods.list(None)["items"] if client else None
+        miss_n, stale_n = self.comparer.compare_nodes(node_items)
+        miss_p, stale_p = self.comparer.compare_pods(pod_items)
+        drift = self.comparer.verify_staging()
+        found = {"nodes_missing": len(miss_n), "nodes_stale": len(stale_n),
+                 "pods_missing": len(miss_p), "pods_stale": len(stale_p),
+                 "staging_drift": len(drift)}
+        total = sum(found.values())
+        if not total:
+            return found
+        self.divergences += total
+        for kind, n in found.items():
+            if n:
+                CACHE_CONSISTENCY_DIVERGENCES.inc(n, kind=kind)
+        self.log(f"cache consistency sweep: divergence {found} — healing "
+                 f"with a full re-encode")
+        self._heal(miss_n, stale_n, miss_p, stale_p, node_items, pod_items)
+        self.heals += 1
+        CACHE_CONSISTENCY_HEALS.inc()
+        return found
+
+    def _heal(self, miss_n, stale_n, miss_p, stale_p,
+              node_items, pod_items) -> None:
+        """Reconcile cache contents from the SAME listed truth the compare
+        diagnosed from, then invalidate the snapshot: the next wave
+        rebuilds staging + device tables from scratch (the one fix that
+        covers every drift class at once)."""
+        from ..api.v1 import node_from_v1, pod_from_v1
+        from ..machinery import meta
+        from ..state.cache import CacheError
+
+        cache = self.scheduler.cache
+        if self.comparer.client is not None:
+            by_name = {meta.name(n): n for n in node_items}
+            for name in miss_n:
+                obj = by_name.get(name)
+                if obj is not None:
+                    cache.add_node(node_from_v1(obj))
+            for name in stale_n:
+                try:
+                    cache.remove_node(name)
+                except CacheError:
+                    pass
+            pods_by_key = {
+                f"{meta.namespace(p)}/{meta.name(p)}": p
+                for p in pod_items
+                if p.get("spec", {}).get("nodeName")}
+            for key in miss_p:
+                obj = pods_by_key.get(key)
+                if obj is not None:
+                    try:
+                        cache.add_pod(pod_from_v1(obj))
+                    except CacheError:
+                        pass
+            for key in stale_p:
+                try:
+                    cache.remove_pod(key)
+                except CacheError:
+                    pass
+        cache.invalidate_snapshot()
 
 
 def install_sigusr2(comparer: CacheComparer, log=print) -> bool:

@@ -33,6 +33,23 @@ PREEMPTION_ATTEMPTS = REG.counter(
 WAVE_SIZE = REG.histogram(
     "scheduler_wave_batch_size", "Pods per batched device wave",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192))
+# cache-consistency sweep (sched/debugger.py ConsistencySweeper — the kube
+# cacheComparer made periodic): divergences found between the resident
+# encoded state and informer truth, and self-heal re-encodes taken
+CACHE_CONSISTENCY_SWEEPS = REG.counter(
+    "scheduler_cache_consistency_sweeps_total",
+    "Cache-vs-informer consistency sweeps run")
+CACHE_CONSISTENCY_DIVERGENCES = REG.counter(
+    "scheduler_cache_consistency_divergences_total",
+    "Divergences found by the consistency sweep", labels=("kind",))
+CACHE_CONSISTENCY_HEALS = REG.counter(
+    "scheduler_cache_consistency_heals_total",
+    "Self-heal full re-encodes triggered by the sweep")
+# restart/HA (sched/ledger.py): intent replay outcomes per recovery pass
+RECOVERED_INTENTS = REG.counter(
+    "scheduler_recovered_bind_intents_total",
+    "Unretired bind intents replayed at startup/takeover",
+    labels=("outcome",))
 
 
 def observe_wave(stats, queue_lengths, cache_counts) -> None:

@@ -352,6 +352,24 @@ class BucketPrewarmer:
             self._inflight = t
         return True
 
+    def ensure_warm(self, d: Dims, engine: str = "waves", extras: tuple = (),
+                    gang: bool = False, mesh=None) -> bool:
+        """The warm-standby beat (Scheduler.warm_standby): compile this
+        exact signature in the background IF it is neither compiled nor
+        already compiling — idempotent, unlike rewarm (which always
+        respawns; it is the re-admission path where the old executable is
+        known-poisoned). Returns True when a compile was scheduled."""
+        if not self.enabled or max(d.N, d.E) < self.min_axis:
+            return False
+        key = (replace(d, has_node_name=False), engine, extras, gang,
+               self._mesh_sig(mesh))
+        with self._mu:
+            # _warmed covers both finished compiles (the key stays) and
+            # in-flight ones (added before the thread starts)
+            if key in self._warmed:
+                return False
+        return self.rewarm(d, engine, extras, gang, mesh)
+
     # ---- preemption-burst program (sched/preemption.py _preempt) ---- #
 
     @classmethod

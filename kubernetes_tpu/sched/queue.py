@@ -183,6 +183,58 @@ class PriorityQueue:
             e = _Entry(pod=pod, attempts=attempts, timestamp=now)
             self._push_active(e)
 
+    def requeue_recovered(self, pod: Pod, attempts: int = 1,
+                          now: float = 0.0) -> str:
+        """Crash-recovery re-admission (sched/ledger.py replay): a pod
+        released from an unretired bind intent must end up in EXACTLY ONE
+        queue lane, and that lane must be activeQ — recovery wants a prompt
+        retry, and the pod may ALREADY sit in backoff/unschedulable on this
+        incarnation (a standby's informers delivered it as pending, a prior
+        wave failed it) when the replay re-admits it. Rules:
+
+          already active         → keep that entry (no duplicate)
+          parked in backoff      → promote to activeQ (crash recovery does
+                                   not wait out a backoff served against a
+                                   DEAD leader's verdicts)
+          parked unschedulable   → promote to activeQ
+          absent                 → add to activeQ
+
+        Attempt counts merge (max) so the promoted entry keeps its backoff
+        history for the NEXT failure. Returns the lane the pod ended in
+        ("active" always) — callers assert, tests introspect via lanes()."""
+        with self._mu:
+            if pod.key in self._active_keys:
+                return "active"
+            e = self._backoff_keys.pop(pod.key, None)
+            if e is None:
+                e = self._unschedulable.pop(pod.key, None)
+            attempts = max(attempts, e.attempts if e else 0)
+            # the popped backoff-heap tuple (if any) becomes stale and is
+            # lazily discarded at pump time via the identity check
+            self._push_active(_Entry(pod=pod, attempts=attempts,
+                                     timestamp=now))
+            return "active"
+
+    def get_pod(self, key: str) -> Optional[Pod]:
+        """The pod behind `key` in WHICHEVER lane holds it (active, backoff
+        or unschedulable), else None. Intent replay's default informer-truth
+        lookup reads this: a pod parked in backoff at crash time is still a
+        live pending pod, not a deleted one."""
+        with self._mu:
+            e = (self._active_keys.get(key)
+                 or self._backoff_keys.get(key)
+                 or self._unschedulable.get(key))
+            return e.pod if e is not None else None
+
+    def lanes(self, key: str) -> Tuple[bool, bool, bool]:
+        """(in activeQ, in backoffQ, in unschedulableQ) membership — the
+        dedupe introspection the crash-requeue tests assert with (a pod must
+        never be live in two lanes; heap leftovers don't count, the key maps
+        are the ground truth the pop paths honor)."""
+        with self._mu:
+            return (key in self._active_keys, key in self._backoff_keys,
+                    key in self._unschedulable)
+
     def peek_active(self, max_n: int) -> List[Pod]:
         """Non-destructive view of up to max_n pods waiting in activeQ (heap
         order, approximately). The scheduler's double-buffer uses this to

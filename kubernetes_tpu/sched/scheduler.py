@@ -91,8 +91,20 @@ class Scheduler:
         extenders: Sequence["object"] = (),
         framework: Optional["object"] = None,
         mesh: object = None,
+        ledger: Optional["object"] = None,
+        fence_source: Optional[Callable[[], int]] = None,
     ) -> None:
         self.binder = binder
+        # exactly-once binding across crash/restart (sched/ledger.py): when
+        # a BindIntentLedger is attached, every wave's placements are
+        # durably recorded BEFORE the first Binding write and retired after
+        # the last — a crash anywhere in between is recoverable via
+        # `recover()`. None (the default) keeps the in-memory-only pipeline.
+        self.ledger = ledger
+        # fencing token source (LeaderElector.fencing_token): stamped into
+        # every intent record; the API binder stamps it into Binding writes
+        # so the apiserver can reject a deposed leader. None = token 0.
+        self.fence_source = fence_source
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
         self.scheduler_name = scheduler_name
@@ -451,6 +463,7 @@ class Scheduler:
             self.cache.mark_dispatch_done()
 
         failures: List[Tuple[Pod, int]] = []
+        commits: List[Tuple[Pod, str, int]] = []
         wave_order = wave_ctx["node_order"]  # set by a fallback re-encode
         for i, (pod, attempts) in enumerate(batch):
             ni = int(node_idx[i])
@@ -462,8 +475,27 @@ class Scheduler:
                 # already assumed/bound (e.g. an update raced the informer
                 # confirmation) — do not double-assume
                 continue
-            node_name = wave_order[ni]
+            commits.append((pod, wave_order[ni], attempts))
+        # write-ahead intent: the whole wave's placements go durable in ONE
+        # CAS create before the first Binding write; retired after the last.
+        # A crash at pre_intent leaves nothing (pods re-deliver as pending),
+        # at post_intent leaves an intent recover() completes-or-releases,
+        # at post_bind leaves an intent recover() simply retires against
+        # informer truth (docs/RESILIENCE.md restart matrix).
+        try:
+            intent = self._write_intent(cycle, commits)
+        except Exception:  # noqa: BLE001 - ledger storage unavailable
+            # no durable intent → no Binding may commit (the write-ahead
+            # contract). The pods are fine: prompt-requeue the would-be
+            # commits, crash-consistently like an abandoned dispatch.
+            for pod, _node, attempts in commits:
+                stats.aborted += 1
+                self.queue.add_prompt_retry(pod, attempts=attempts, now=now)
+            commits = []
+            intent = None
+        for pod, node_name, attempts in commits:
             self._commit(pod, node_name, attempts, now, cycle, stats)
+        self._retire_intent(intent)
 
         # ---- preemption pass: AFTER commits, against ONE fresh snapshot so
         # the what-if sees pods assumed earlier in this very wave (otherwise
@@ -621,7 +653,158 @@ class Scheduler:
         best = max(feasible, key=lambda n: combined.get(n, float("-inf")))
         binder_ext = next(
             (e for e in self.extenders if e.is_binder and e.is_interested(pod)), None)
+        try:
+            intent = self._write_intent(cycle, [(pod, best, attempts)])
+        except Exception:  # noqa: BLE001 - same contract as the wave path
+            stats.aborted += 1
+            self.queue.add_prompt_retry(pod, attempts=attempts, now=now)
+            return
         self._commit(pod, best, attempts, now, cycle, stats, binder_ext=binder_ext)
+        self._retire_intent(intent)
+
+    # ------------------------------------------------------------------ #
+    # exactly-once plumbing: intent ledger + fencing + crash recovery
+    # (sched/ledger.py; docs/RESILIENCE.md §Restart/HA)
+    # ------------------------------------------------------------------ #
+
+    def _fence_token(self) -> int:
+        """The current fencing token (lease generation). 0 without leader
+        election — the apiserver only fences when a Lease exists."""
+        return int(self.fence_source()) if self.fence_source is not None \
+            else 0
+
+    def _write_intent(self, cycle: int,
+                      commits: Sequence[Tuple[Pod, str, int]]):
+        """Durably record the wave's placements before any Binding write
+        (no-op without a ledger). Crashpoints bracket the write so the kill
+        matrix can die exactly before/after it."""
+        if self.ledger is None or not commits:
+            return None
+        from ..utils import faultline
+
+        faultline.crashpoint("pre_intent")
+        intent = self.ledger.write_intent(
+            cycle=cycle, token=self._fence_token(),
+            bindings={p.key: node for p, node, _ in commits})
+        faultline.crashpoint("post_intent")
+        return intent
+
+    def _retire_intent(self, intent) -> None:
+        if intent is None:
+            return
+        from ..utils import faultline
+
+        faultline.crashpoint("post_bind")
+        try:
+            self.ledger.retire(intent)
+        except Exception:  # noqa: BLE001 - a failed retire is SAFE: the
+            # next recover() replays the record against informer truth and
+            # finds every entry already settled — never double-bound
+            pass
+
+    def node_fits(self, pod: Pod, node_name: str) -> bool:
+        """Host-side feasibility for intent replay: does `node_name` still
+        hold the pod's requests given everything bound/assumed there NOW?
+        Deliberately resource-only (the cheap, always-available subset,
+        evaluated by the executable oracle api/semantics.pod_fits_resources):
+        replay prefers completing a crashed leader's decision when it is
+        still sane, and releases to the queue — where the full device
+        evaluation reruns — when in doubt."""
+        from ..api.semantics import pod_fits_resources
+
+        node = self.cache.get_node(node_name)
+        if node is None:
+            return False
+        occupants = self.cache.pods_on_node(node_name)
+        used_sc: Dict[str, int] = {}
+        for p in occupants:
+            for k, v in p.requests.scalars:
+                used_sc[k] = used_sc.get(k, 0) + v
+        from ..api.types import Resources
+
+        used = Resources(
+            milli_cpu=sum(p.requests.milli_cpu for p in occupants),
+            memory_kib=sum(p.requests.memory_kib for p in occupants),
+            ephemeral_kib=sum(p.requests.ephemeral_kib for p in occupants),
+            scalars=tuple(sorted(used_sc.items())))
+        ok, _fails = pod_fits_resources(pod, node, used, len(occupants))
+        return ok
+
+    def commit_recovered(self, pod: Pod, node_name: str,
+                         now: Optional[float] = None) -> bool:
+        """Complete one replayed intent entry: assume → fenced bind →
+        finish_binding, with the plain rollback on refusal (most commonly
+        the apiserver's already-assigned guard when our informer lagged the
+        crashed leader's committed write).
+
+        Only valid on the PLAIN pipeline: with a framework (Reserve/Permit/
+        PreBind gates) or extenders configured, the crashed wave's intent
+        was written BEFORE those points ran, so completing the bind here
+        would commit a placement a plugin might have refused — refuse
+        instead, and let the release path re-run the full gauntlet."""
+        now = self.clock() if now is None else now
+        if self.framework is not None or self.extenders:
+            return False  # gates must re-run: release → full pipeline
+        if self.cache.get_pod(pod.key) is not None:
+            return False  # already assumed/bound in this incarnation
+        self.cache.assume_pod(pod, node_name)
+        try:
+            ok = bool(self.binder.bind(pod, node_name))
+        except Exception:  # noqa: BLE001 - a raising binder is a refusal
+            ok = False
+        if ok:
+            self.cache.finish_binding(pod.key, now)
+            self.queue.delete(pod.key)
+            return True
+        self.cache.forget_pod(pod.key)
+        return False
+
+    def recover(self, lookup=None, now: Optional[float] = None):
+        """Startup/takeover reconciliation: replay every unretired bind
+        intent against informer truth (sched/ledger.py replay — the full
+        decision table lives there). `lookup(pod_key)` must return the
+        live Pod (node_name = the apiserver's view) or None; the default
+        reads this scheduler's own cache+queue, which suffices once the
+        informers have synced. Returns a RecoveryReport (None w/o ledger)."""
+        if self.ledger is None:
+            return None
+        if lookup is None:
+            lookup = self._cache_lookup
+        return self.ledger.replay(self, lookup, now=now)
+
+    def _cache_lookup(self, pod_key: str) -> Optional[Pod]:
+        pod = self.cache.get_pod(pod_key)
+        if pod is not None:
+            return pod
+        # not bound: an unbound pending pod lives in SOME queue lane —
+        # including backoff/unschedulable (a pre-crash failure verdict
+        # must not read as "pod deleted")
+        return self.queue.get_pod(pod_key)
+
+    def warm_standby(self) -> None:
+        """One warm-standby beat (the non-leading half of HA failover): keep
+        the encoder/staging/device state and the prewarmed executables HOT
+        from informer truth without popping, assuming, or binding anything.
+        A takeover then skips cold-compile and full re-ingest — the first
+        led wave patches an already-resident snapshot and hits a warm
+        executable. Strictly read-only against queue and apiserver."""
+        backlog = self.queue.peek_active(self.batch_size)
+        self.encoder.intern_pods(backlog)
+        snap, _keys = self._snapshot_keys(backlog)
+        from .cycle import _engine
+
+        wave_engine = "scan" if snap.dims.has_node_name else _engine()
+        extras = tuple(p for p, _ in self._extra_score)
+        gang = self._device_gangs and snap.gang is not None
+        # compile the signature the first led wave WILL dispatch (idempotent
+        # per signature), and keep the growth-boundary lookahead running so
+        # a takeover into a growing cluster doesn't stall either
+        self.prewarmer.ensure_warm(snap.dims, wave_engine, extras, gang,
+                                   mesh=snap.mesh)
+        self.prewarmer.observe(
+            snap.dims, n_nodes=self.cache.node_count,
+            n_existing=self.cache.pod_count,
+            engine=wave_engine, extras=extras, gang=gang, mesh=snap.mesh)
 
     # ------------------------------------------------------------------ #
     # commit path: assume → Reserve → Permit → PreBind → Bind → PostBind

@@ -38,23 +38,48 @@ Obj = Dict[str, Any]
 class APIBinder:
     """Binder over POST pods/{name}/binding (scheduler.go:565). When volume
     binding is wired, BindPodVolumes runs first (scheduler.go:660,517) and a
-    volume failure aborts the pod bind → assume rollback."""
+    volume failure aborts the pod bind → assume rollback.
 
-    def __init__(self, client, volume_binder=None, pod_lookup=None):
+    Fenced: with a `fence_source` attached (leader election), every Binding
+    is stamped with the current lease generation so the apiserver can
+    reject a deposed leader's write (api.types.FENCING_TOKEN_ANNOTATION;
+    apiserver/server.py `bind_pod`)."""
+
+    def __init__(self, client, volume_binder=None, pod_lookup=None,
+                 fence_source=None,
+                 fence_lease: str = ""):
+        from kubernetes_tpu.api.types import DEFAULT_FENCING_LEASE
+
         self.client = client
         self.volume_binder = volume_binder
         self.pod_lookup = pod_lookup  # (ns, name) -> dict pod or None
+        self.fence_source = fence_source  # () -> int lease generation
+        self.fence_lease = fence_lease or DEFAULT_FENCING_LEASE
+        self.stale_rejects = 0  # fenced-off binds (the mechanism working)
 
     def bind(self, pod: Pod, node_name: str) -> bool:
+        from kubernetes_tpu.api.types import (FENCED_BIND_MARKER,
+                                              FENCING_LEASE_ANNOTATION,
+                                              FENCING_TOKEN_ANNOTATION)
+
         if self.volume_binder is not None and self.pod_lookup is not None:
             obj = self.pod_lookup(pod.namespace, pod.name)
             if obj is not None and not self.volume_binder.bind(obj, node_name):
                 return False
+        annotations = None
+        if self.fence_source is not None:
+            annotations = {
+                FENCING_TOKEN_ANNOTATION: str(int(self.fence_source())),
+                FENCING_LEASE_ANNOTATION: self.fence_lease,
+            }
         try:
             self.client.pods.bind(pod.name, node_name, pod.namespace,
-                                  uid=pod.uid)
+                                  uid=pod.uid, annotations=annotations)
             return True
-        except errors.StatusError:
+        except errors.StatusError as e:
+            if annotations is not None and errors.is_conflict(e) \
+                    and FENCED_BIND_MARKER in str(e):
+                self.stale_rejects += 1
             return False
 
 
@@ -87,7 +112,10 @@ class SchedulerServer:
                  leader_elect: bool = False,
                  volume_binding: bool = True,
                  config=None,
-                 base_dims=None):
+                 base_dims=None,
+                 ledger=None,
+                 lease_config: Optional[Dict[str, Any]] = None,
+                 standby_warm_interval: float = 2.0):
         from kubernetes_tpu.state.dims import Dims
 
         # ComponentConfig / Policy surface (apis/config/types.go:45-112 →
@@ -195,9 +223,29 @@ class SchedulerServer:
             self.elector = LeaderElector(client, LeaderElectionConfig(
                 lock_name="kube-scheduler",
                 on_started_leading=self._active.set,
-                on_stopped_leading=self._active.clear))
+                on_stopped_leading=self._on_stopped_leading,
+                **(lease_config or {})))
+            # fencing: the scheduler stamps the elector's lease generation
+            # into intents; the API binder stamps it into Binding writes
+            self.scheduler.fence_source = \
+                lambda: self.elector.fencing_token
+            if isinstance(self.scheduler.binder, APIBinder):
+                self.scheduler.binder.fence_source = \
+                    lambda: self.elector.fencing_token
         else:
             self._active.set()
+        # exactly-once restart/HA (sched/ledger.py): with a ledger attached,
+        # every (re)acquisition of leadership — including plain process
+        # start — reconciles unretired bind intents BEFORE the first wave
+        self.scheduler.ledger = ledger if ledger is not None \
+            else self.scheduler.ledger
+        self.standby_warm_interval = standby_warm_interval
+        self._standby_last = 0.0
+        self._needs_recover = self.scheduler.ledger is not None
+        self.last_recovery = None      # RecoveryReport of the latest pass
+        self.last_recovery_error = None
+        self.takeovers = 0             # leadership activations that ran one
+        self._crashed = False
         self.total_scheduled = 0
         self.total_unschedulable_events = 0
 
@@ -321,13 +369,81 @@ class SchedulerServer:
         for t in self._threads:
             t.join(timeout=2)
 
+    def crash(self) -> None:
+        """Simulated abrupt process death (restart drills, bench failover
+        stage): the loop and informers stop, but the Lease is NOT released,
+        no callbacks fire, and nothing is requeued or flushed — whatever
+        the bind pipeline had in flight stays exactly where the 'kill'
+        caught it (unretired intents included). The next leader's
+        reconciliation is what cleans up — that is the thing under test."""
+        self._crashed = True
+        self._stop.set()
+        if self.elector is not None:
+            self.elector.crash()
+        for inf in (self.pod_informer, self.node_informer,
+                    self.pdb_informer):
+            if inf is not None:
+                inf.stop()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _on_stopped_leading(self) -> None:
+        """Any leadership loss re-arms the reconciliation pass HERE, on the
+        elector thread — not only in the loop's standby branch. A loop
+        wedged inside a long degraded wave can lose and re-acquire the
+        lease without ever observing the inactive state; arming on the
+        callback guarantees the re-acquisition still replays whatever the
+        interim leader left unretired before serving a single wave."""
+        self._needs_recover = self.scheduler.ledger is not None
+        self._active.clear()
+
+    def _lookup_pod(self, pod_key: str):
+        """Informer truth for intent replay: the live pod (node_name = the
+        apiserver's committed view) or None when deleted."""
+        from kubernetes_tpu.api.v1 import pod_from_v1
+
+        ns, name = meta.split_key(pod_key)
+        obj = self.pod_informer.lister.get(ns, name) \
+            if self.pod_informer is not None else None
+        if obj is None:
+            return None
+        return self._to_pod(obj) if not obj.get("spec", {}).get("nodeName") \
+            else pod_from_v1(obj)
+
     # -- the loop (wait.Until(scheduleOne) → batched waves) ------------------ #
 
     def _loop(self) -> None:
         while not self._stop.is_set():
             if not self._active.is_set():
+                # warm standby: the next activation must find compiled
+                # executables and a resident snapshot, not a cold encoder —
+                # failover skips cold-compile and full re-ingest
+                self._needs_recover = self.scheduler.ledger is not None
+                now = time.monotonic()
+                if now - self._standby_last >= self.standby_warm_interval:
+                    self._standby_last = now
+                    with self._mu:
+                        try:
+                            self.scheduler.warm_standby()
+                        except Exception:  # noqa: BLE001 - standby warmth
+                            pass           # is an optimization, never fatal
                 self._stop.wait(0.2)
                 continue
+            if self._needs_recover:
+                # first led beat (process start, or a takeover): replay
+                # unretired bind intents against informer truth before any
+                # wave pops a pod — exactly-once binding across the handoff
+                self._needs_recover = False
+                with self._mu:
+                    try:
+                        self.last_recovery = self.scheduler.recover(
+                            lookup=self._lookup_pod)
+                        self.takeovers += 1
+                    except Exception as e:  # noqa: BLE001 - a failed
+                        # recovery pass leaves the intents unretired for
+                        # the next one; scheduling proceeds (pods are
+                        # requeued by informer truth regardless)
+                        self.last_recovery_error = e
             with self._mu:
                 pending = self.scheduler.queue.lengths()[0]
             if pending and self.batch_window:

@@ -338,6 +338,51 @@ class SchedulerCache:
             st = self._pods.get(key)
             return bool(st and st.assumed)
 
+    def forget_assumed(self) -> List[Pod]:
+        """Drop EVERY assumed-but-unconfirmed pod (takeover reconciliation,
+        sched/ledger.py replay): a new leader must rebuild its optimistic
+        state from informer truth + the intent ledger, never trust assumes
+        made before the fence — they may mirror a deposed reign's decisions
+        the apiserver rejected. Returns the forgotten Pod objects (their
+        node_name still carries the assumed placement) so the caller can
+        requeue them even when no other record of them survives."""
+        dropped: List[Pod] = []
+        with self._mu:
+            for key, st in list(self._pods.items()):
+                if st.assumed:
+                    del self._pods[key]
+                    self._pod_unplaced(st.pod)
+                    dropped.append(st.pod)
+            if dropped:
+                self._generation += 1
+        return dropped
+
+    def pods_on_node(self, name: str) -> List[Pod]:
+        """All pods (bound + assumed) occupying one node — the host-side
+        feasibility check of intent replay reads this."""
+        with self._mu:
+            return list(self._by_node.get(name, {}).values())
+
+    def get_node(self, name: str) -> Optional[Node]:
+        with self._mu:
+            return self._nodes.get(name)
+
+    def invalidate_snapshot(self) -> None:
+        """Force the next snapshot onto the FULL re-encode path (scratch
+        staging + one device transfer), discarding the incremental state.
+        This is the consistency sweep's self-heal (sched/debugger.py): when
+        the patched staging arrays diverge from a from-scratch encode, the
+        cheap fix is to stop trusting them."""
+        with self._mu:
+            self._snapshot = None
+            self._staging_nodes = None
+            self._staging_pod_rows = None
+            self._staging_pod_valid = None
+            self._staging_pod_node = None
+            self._pending_stage = None
+            self._pending_stage_keys = None
+            self._generation += 1
+
     def get_pod(self, key: str) -> Optional[Pod]:
         with self._mu:
             st = self._pods.get(key)

@@ -145,7 +145,6 @@ class Storage:
         update_fn receives a deep copy (with resourceVersion set) and returns
         the new object, or raises to abort.
         """
-        first = True
         chaos_cas = False  # at most one injected conflict per call: the
         # retry loop must converge even under FAULT_SPEC=store.cas_conflict@1.0
         while True:
@@ -158,13 +157,21 @@ class Storage:
             else:
                 cur = _decode(rec.value, rec.mod_rev)
                 cur_mod = rec.mod_rev
-            if (first and expected_rv is not None and rec is not None
+            if (expected_rv is not None and rec is not None
                     and str(rec.mod_rev) != expected_rv):
+                # the precondition holds on EVERY iteration, not just the
+                # first: when our txn_put loses the CAS race to a
+                # concurrent writer, the retry re-reads a revision past
+                # the caller's precondition and MUST conflict — retrying
+                # with the stale body would silently stomp the winner
+                # (observed: a lease renew racing a usurper's claim
+                # overwrote it and kept the incumbent leading — the exact
+                # window lease fencing closes). etcd3 store.go preconditions
+                # are checked per attempt for the same reason.
                 raise errors.new_conflict(
                     resource, name or key,
                     "the object has been modified; please apply your changes "
                     "to the latest version and try again")
-            first = False
             updated = update_fn(meta.deep_copy(cur))
             if not chaos_cas and faultline.should("store.cas_conflict",
                                                   "guaranteed_update"):

@@ -37,6 +37,16 @@ Seams wired in this repo (fault name → injection point):
     watch.drop / watch.relist                 client/informers.py reflector
     native.dlopen                             storage/native.py new_kv()
     apiserver.restart                         apiserver/server.py handle_rest
+    proc.crash                                sched/scheduler.py bind
+                                              lifecycle + sched/ledger.py
+                                              reconciliation (sites:
+                                              pre_intent, post_intent,
+                                              post_bind, takeover) — raises
+                                              InjectedCrash, a BaseException
+                                              that punches through every
+                                              `except Exception` guard the
+                                              way SIGKILL punches through a
+                                              process (restart drills)
 
 The hot-path contract: when no spec is installed, ``should()`` is one global
 read and a ``None`` check — safe to call per storage CAS or per watch event.
@@ -55,6 +65,16 @@ from typing import Dict, List, Optional
 class InjectedDeviceError(RuntimeError):
     """Stand-in for XlaRuntimeError raised by a chaos-injected device fault.
     The dispatch supervisor treats it exactly like the real thing."""
+
+
+class InjectedCrash(BaseException):
+    """Simulated abrupt process death (the SIGKILL analog) at a `proc.crash`
+    crashpoint. Deliberately a BaseException: a real kill does not run
+    `except Exception` recovery handlers, so neither does this — it unwinds
+    straight out of the scheduling loop, leaving whatever durable state the
+    crashed point had already committed (the bind-intent ledger, Binding
+    writes) exactly as a power cut would. Restart drills catch it at the
+    test/bench harness level and bring up a fresh scheduler incarnation."""
 
 
 class FaultSpecError(ValueError):
@@ -195,6 +215,15 @@ def should(fault: str, site: str = "") -> bool:
     """The seam entry point. Near-zero cost when no injector is installed."""
     fl = _active
     return fl is not None and fl.should(fault, site)
+
+
+def crashpoint(site: str) -> None:
+    """A `proc.crash@site` seam in the bind lifecycle: when the spec names
+    this site, the process "dies" here (InjectedCrash). Sites wired:
+    pre_intent / post_intent / post_bind (sched/scheduler.py wave commit)
+    and takeover (sched/ledger.py reconciliation replay)."""
+    if should("proc.crash", site):
+        raise InjectedCrash(f"proc.crash@{site}")
 
 
 # env-driven startup: a process launched with FAULT_SPEC set is under chaos
