@@ -22,14 +22,14 @@ selectHost (generic_scheduler.go:290-311).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..state.arrays import Array, ClusterTables, PodArrays
 from .fit import fit_row, resource_scores_row
-from .interpod import affinity_rows, domain_of_term, soft_affinity_row
+from .interpod import affinity_rows, soft_affinity_row
 from .lattice import CycleArrays
 from .ports import port_conflict_row
 from .scores import even_spread_soft_row, selector_spread_row
@@ -61,66 +61,79 @@ def queue_order(pods: PodArrays) -> Array:
     return jnp.lexsort((pods.creation, -pods.priority, ~pods.valid))
 
 
+def assign_step(
+    tables: ClusterTables,
+    cyc: CycleArrays,
+    state: AssignState,
+    c: Array,
+    p_valid: Array,
+    node_name_req: Array,
+) -> Tuple[AssignState, Array, Array]:
+    """ONE pod's Filter → Score → selectHost → assume against a live state —
+    the body of the sequential scan, factored out so the run-collapsed
+    engine's per-pod fallback (ops/runs.py) executes the IDENTICAL op
+    sequence (bit-equality between the engines is by shared code, not by
+    re-derivation). Returns (new state, node index or -1, feasible)."""
+    classes = tables.classes
+    req_vec = tables.reqs.vec[classes.rid[c]]
+    ps = classes.portset[c]
+    psafe = jnp.maximum(ps, 0)
+
+    mask = pod_mask_row(tables, cyc, state, c, node_name_req, p_valid)
+
+    # ---- Score row (weighted sum; component weights/enables come from
+    #      the traced EngineConfig — generic_scheduler.go:823-832) ----
+    score = score_row(tables, cyc, state, c)
+    score = jnp.where(mask, score, -jnp.inf)
+
+    choice = jnp.argmax(score)
+    feasible = mask.any() & p_valid
+    node = jnp.where(feasible, choice, -1)
+
+    # ---- assume: commit to carry (cache.AssumePod analog) ----
+    add = jnp.where(feasible, req_vec, 0)
+    used = state.used.at[choice].add(add)
+
+    live_ps = feasible & (ps >= 0)
+    pw = jnp.where(live_ps, tables.portsets.pair_words[psafe], 0)
+    ww = jnp.where(live_ps, tables.portsets.wild_words[psafe], 0)
+    tw = jnp.where(live_ps, tables.portsets.trip_words[psafe], 0)
+    ppa = state.ppa.at[choice].set(state.ppa[choice] | pw)
+    ppw = state.ppw.at[choice].set(state.ppw[choice] | ww)
+    ppt = state.ppt.at[choice].set(state.ppt[choice] | tw)
+
+    # affinity/spread counts: this pod now matches its terms at its node
+    inc = (cyc.TM[:, c] & feasible).astype(jnp.int32)   # [S]
+    CNT = state.CNT.at[:, choice].add(inc)
+    inc_h = (cyc.has_anti[c] & feasible).astype(jnp.int32)
+    HOLD = state.HOLD.at[:, choice].add(inc_h)
+    WSYM = state.WSYM.at[:, choice].add(
+        jnp.where(feasible, cyc.WCOLS[:, c], 0.0))
+
+    vs = tables.classes.volset[c]
+    live_vs = feasible & (vs >= 0)
+    va = jnp.where(live_vs, tables.volsets.any_words[jnp.maximum(vs, 0)], 0)
+    vr = jnp.where(live_vs, tables.volsets.rw_words[jnp.maximum(vs, 0)], 0)
+    vol_any = state.vol_any.at[choice].set(state.vol_any[choice] | va)
+    vol_rw = state.vol_rw.at[choice].set(state.vol_rw[choice] | vr)
+
+    return AssignState(used, ppa, ppw, ppt, CNT, HOLD, WSYM,
+                       vol_any, vol_rw), node, feasible
+
+
 def assign_batch(
     tables: ClusterTables,
     cyc: CycleArrays,
     pods: PodArrays,
     init: AssignState,
 ) -> AssignResult:
-    nodes = tables.nodes
-    classes = tables.classes
-    terms = tables.terms
-    D = cyc.ELD.shape[2] - 1
-
     order = queue_order(pods)
 
     def step(state: AssignState, idx):
-        c = pods.cls[idx]
-        p_valid = pods.valid[idx]
-        req_vec = tables.reqs.vec[classes.rid[c]]
-        ps = classes.portset[c]
-        psafe = jnp.maximum(ps, 0)
-
-        mask = pod_mask_row(tables, cyc, state, c, pods.node_name_req[idx], p_valid)
-
-        # ---- Score row (weighted sum; component weights/enables come from
-        #      the traced EngineConfig — generic_scheduler.go:823-832) ----
-        score = score_row(tables, cyc, state, c)
-        score = jnp.where(mask, score, -jnp.inf)
-
-        choice = jnp.argmax(score)
-        feasible = mask.any() & p_valid
-        node = jnp.where(feasible, choice, -1)
-
-        # ---- assume: commit to carry (cache.AssumePod analog) ----
-        add = jnp.where(feasible, req_vec, 0)
-        used = state.used.at[choice].add(add)
-
-        live_ps = feasible & (ps >= 0)
-        pw = jnp.where(live_ps, tables.portsets.pair_words[psafe], 0)
-        ww = jnp.where(live_ps, tables.portsets.wild_words[psafe], 0)
-        tw = jnp.where(live_ps, tables.portsets.trip_words[psafe], 0)
-        ppa = state.ppa.at[choice].set(state.ppa[choice] | pw)
-        ppw = state.ppw.at[choice].set(state.ppw[choice] | ww)
-        ppt = state.ppt.at[choice].set(state.ppt[choice] | tw)
-
-        # affinity/spread counts: this pod now matches its terms at its node
-        inc = (cyc.TM[:, c] & feasible).astype(jnp.int32)   # [S]
-        CNT = state.CNT.at[:, choice].add(inc)
-        inc_h = (cyc.has_anti[c] & feasible).astype(jnp.int32)
-        HOLD = state.HOLD.at[:, choice].add(inc_h)
-        WSYM = state.WSYM.at[:, choice].add(
-            jnp.where(feasible, cyc.WCOLS[:, c], 0.0))
-
-        vs = tables.classes.volset[c]
-        live_vs = feasible & (vs >= 0)
-        va = jnp.where(live_vs, tables.volsets.any_words[jnp.maximum(vs, 0)], 0)
-        vr = jnp.where(live_vs, tables.volsets.rw_words[jnp.maximum(vs, 0)], 0)
-        vol_any = state.vol_any.at[choice].set(state.vol_any[choice] | va)
-        vol_rw = state.vol_rw.at[choice].set(state.vol_rw[choice] | vr)
-
-        return AssignState(used, ppa, ppw, ppt, CNT, HOLD, WSYM,
-                           vol_any, vol_rw), (node, feasible)
+        state, node, feasible = assign_step(
+            tables, cyc, state, pods.cls[idx], pods.valid[idx],
+            pods.node_name_req[idx])
+        return state, (node, feasible)
 
     final, (nodes_sorted, feas_sorted) = jax.lax.scan(step, init, order)
 
@@ -128,6 +141,75 @@ def assign_batch(
     node_out = jnp.full((P,), -1, jnp.int32).at[order].set(nodes_sorted)
     feas_out = jnp.zeros((P,), bool).at[order].set(feas_sorted)
     return AssignResult(node=node_out, feasible=feas_out, state=final)
+
+
+def mask_context_row(
+    tables: ClusterTables,
+    cyc: CycleArrays,
+    state: AssignState,
+    cls: Array,
+    node_name_req: Array,
+    valid: Array,
+) -> Array:
+    """The Filter components that are CONSTANT across a run of same-class
+    replicas when the class is self-interaction-free (ops/runs.py): the
+    static lattice, inter-pod affinity/anti-affinity (counts only move at
+    placed nodes, through terms such a class never reads), hard topology
+    spread, spec.nodeName, and pod validity. The run-collapsed engine
+    evaluates this once per RUN; pod_mask_row recomposes it per pod."""
+    from .lattice import _on
+
+    nodes, classes, terms = tables.nodes, tables.classes, tables.terms
+    ecfg = cyc.ecfg
+    D = cyc.ELD.shape[2] - 1
+    aff_ok, anti_ok = affinity_rows(
+        cls, classes, terms, cyc.TM, state.CNT, state.HOLD, nodes, D
+    )
+    interpod_ok = (aff_ok & anti_ok) | ~_on(ecfg.f_interpod)
+    spread_ok = spread_row(
+        cls, classes, terms, cyc.TM, state.CNT, cyc.ELD,
+        cyc.static.node_match[cls], nodes, D,
+    ) | ~_on(ecfg.f_spread)
+    host_ok = (node_name_req < 0) | (nodes.name_id == node_name_req) \
+        | ~_on(ecfg.f_name)
+    return cyc.static.mask[cls] & interpod_ok & spread_ok & host_ok & valid
+
+
+def mask_dynamic_row(
+    tables: ClusterTables,
+    cyc: CycleArrays,
+    cls: Array,
+    used: Array,
+    ppa: Array, ppw: Array, ppt: Array,
+    vol_any: Array, vol_rw: Array,
+) -> Array:
+    """The Filter components that move as replicas of the SAME class land:
+    resources, host ports, volumes — all strictly per-node functions of the
+    passed state planes. The run-collapsed engine re-evaluates exactly this
+    per admission epoch against synthesized per-node planes; the per-pod
+    scan calls it (via pod_mask_row) with the live carry."""
+    from .lattice import _on
+
+    nodes, classes = tables.nodes, tables.classes
+    ecfg = cyc.ecfg
+    rid = classes.rid[cls]
+    req_vec = tables.reqs.vec[rid]
+    fit = fit_row(req_vec, used, nodes.alloc, nodes.valid) \
+        | ~_on(ecfg.f_fit)
+    ps = classes.portset[cls]
+    psafe = jnp.maximum(ps, 0)
+    conflict = port_conflict_row(
+        tables.portsets.wild_words[psafe],
+        tables.portsets.pair_words[psafe],
+        tables.portsets.trip_words[psafe],
+        ppa, ppw, ppt,
+    )
+    port_ok = (ps < 0) | ~conflict | ~_on(ecfg.f_ports)
+    vconf_free, vlimit_ok = volume_components_row(
+        tables, vol_any, vol_rw, cls)
+    vol_ok = (vconf_free | ~_on(ecfg.f_volrestrict)) \
+        & (vlimit_ok | ~_on(ecfg.f_vollimits))
+    return fit & port_ok & vol_ok
 
 
 def pod_mask_row(
@@ -142,43 +224,65 @@ def pod_mask_row(
     tensor analog of podFitsOnNode (generic_scheduler.go:628-706). Shared by
     the assignment scan and the golden-test / extender surfaces. Each
     component honors its EngineConfig plugin flag (a disabled filter plugin
-    never blocks, matching CreateFromKeys composition)."""
-    from .lattice import _on
-
-    nodes, classes, terms = tables.nodes, tables.classes, tables.terms
-    ecfg = cyc.ecfg
-    D = cyc.ELD.shape[2] - 1
-    rid = classes.rid[cls]
-    req_vec = tables.reqs.vec[rid]
-    fit = fit_row(req_vec, state.used, nodes.alloc, nodes.valid) \
-        | ~_on(ecfg.f_fit)
-    ps = classes.portset[cls]
-    psafe = jnp.maximum(ps, 0)
-    conflict = port_conflict_row(
-        tables.portsets.wild_words[psafe],
-        tables.portsets.pair_words[psafe],
-        tables.portsets.trip_words[psafe],
-        state.ppa, state.ppw, state.ppt,
-    )
-    port_ok = (ps < 0) | ~conflict | ~_on(ecfg.f_ports)
-    aff_ok, anti_ok = affinity_rows(
-        cls, classes, terms, cyc.TM, state.CNT, state.HOLD, nodes, D
-    )
-    interpod_ok = (aff_ok & anti_ok) | ~_on(ecfg.f_interpod)
-    spread_ok = spread_row(
-        cls, classes, terms, cyc.TM, state.CNT, cyc.ELD,
-        cyc.static.node_match[cls], nodes, D,
-    ) | ~_on(ecfg.f_spread)
-    host_ok = (node_name_req < 0) | (nodes.name_id == node_name_req) \
-        | ~_on(ecfg.f_name)
-    vconf_free, vlimit_ok = volume_components_row(
-        tables, state.vol_any, state.vol_rw, cls)
-    vol_ok = (vconf_free | ~_on(ecfg.f_volrestrict)) \
-        & (vlimit_ok | ~_on(ecfg.f_vollimits))
+    never blocks, matching CreateFromKeys composition). Composed from the
+    run-constant context half and the per-placement dynamic half — boolean
+    conjunction, so the regrouping is exact."""
     return (
-        cyc.static.mask[cls]
-        & fit & port_ok & interpod_ok & spread_ok & host_ok & vol_ok & valid
+        mask_context_row(tables, cyc, state, cls, node_name_req, valid)
+        & mask_dynamic_row(tables, cyc, cls, state.used,
+                           state.ppa, state.ppw, state.ppt,
+                           state.vol_any, state.vol_rw)
     )
+
+
+class ScoreContext(NamedTuple):
+    """The Score components that stay fixed across a self-interaction-free
+    replica run: the count/weight-aggregated rows whose inputs (CNT/WSYM at
+    terms the class reads) its own placements cannot move."""
+
+    soft_ip: Array    # [N] soft inter-pod affinity, min/max-normalized
+    even_soft: Array  # [N] EvenPodsSpread ScheduleAnyway score
+    ssel: Array       # [N] SelectorSpread score
+
+
+def score_context_row(
+    tables: ClusterTables,
+    cyc: CycleArrays,
+    state: AssignState,
+    cls: Array,
+) -> ScoreContext:
+    nodes, classes, terms = tables.nodes, tables.classes, tables.terms
+    D = cyc.ELD.shape[2] - 1
+    soft_ip = soft_affinity_row(cls, classes, terms, state.CNT, nodes, D,
+                                TM=cyc.TM, WSYM=state.WSYM)
+    even_soft = even_spread_soft_row(
+        cls, classes, terms, state.CNT, nodes, cyc.static.node_match[cls], D)
+    ssel = selector_spread_row(
+        cls, classes, state.CNT, nodes, tables.zone_keys, D)
+    return ScoreContext(soft_ip=soft_ip, even_soft=even_soft, ssel=ssel)
+
+
+def score_combine_row(
+    tables: ClusterTables,
+    cyc: CycleArrays,
+    cls: Array,
+    used: Array,
+    ctx: ScoreContext,
+) -> Array:
+    """The exact weighted-sum expression tree of the Score row, parameterized
+    by the per-node `used` plane. BOTH engines go through this one function
+    — the run-collapsed engine with synthesized used-after-j-replicas planes,
+    the scan with the live carry — so the float op sequence (and therefore
+    every rounding) is identical by construction, which is what makes the
+    argmax chains bit-equal."""
+    nodes, classes = tables.nodes, tables.classes
+    w = cyc.ecfg
+    req_vec = tables.reqs.vec[classes.rid[cls]]
+    least, balanced, most = resource_scores_row(req_vec, used, nodes.alloc)
+    return (cyc.static.score[cls] + least * w.w_least
+            + balanced * w.w_balanced + most * w.w_most
+            + ctx.soft_ip * w.w_interpod + ctx.even_soft * w.w_even
+            + ctx.ssel * w.w_ssel)
 
 
 def score_row(
@@ -189,24 +293,11 @@ def score_row(
 ) -> Array:
     """Full Score row [N] for one pod class against a live assume-state —
     prioritizeNodes' weighted sum (generic_scheduler.go:714-869) with the
-    EngineConfig carrying per-plugin weights. Shared by both engines and the
+    EngineConfig carrying per-plugin weights. Shared by all engines and the
     score-matrix surface."""
-    nodes, classes, terms = tables.nodes, tables.classes, tables.terms
-    w = cyc.ecfg
-    D = cyc.ELD.shape[2] - 1
-    req_vec = tables.reqs.vec[classes.rid[cls]]
-    least, balanced, most = resource_scores_row(req_vec, state.used,
-                                                nodes.alloc)
-    soft_ip = soft_affinity_row(cls, classes, terms, state.CNT, nodes, D,
-                                TM=cyc.TM, WSYM=state.WSYM)
-    even_soft = even_spread_soft_row(
-        cls, classes, terms, state.CNT, nodes, cyc.static.node_match[cls], D)
-    ssel = selector_spread_row(
-        cls, classes, state.CNT, nodes, tables.zone_keys, D)
-    return (cyc.static.score[cls] + least * w.w_least
-            + balanced * w.w_balanced + most * w.w_most
-            + soft_ip * w.w_interpod + even_soft * w.w_even
-            + ssel * w.w_ssel)
+    return score_combine_row(
+        tables, cyc, cls, state.used,
+        score_context_row(tables, cyc, state, cls))
 
 
 def feasible_matrix(

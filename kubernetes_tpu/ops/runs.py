@@ -1,0 +1,367 @@
+"""Run-length-collapsed admission: schedule replica RUNS, not pods.
+
+The sequential scan (ops/assign.py) pays one O(S·N) step per POD — 50k
+serialized steps at the north-star shape — even though `intern_pods`
+(state/encode.py) already proves most pending pods are value-identical
+replicas of a few hundred classes (a Deployment/Job backlog). Queue order
+(priority desc, creation asc) keeps one controller's replicas ADJACENT, so
+the pending wave factors into runs of consecutive same-class pods. This
+engine scans one step per RUN and places a whole run per step:
+
+  1. the queue-ordered wave is run-length encoded ON DEVICE (so gang
+     rejection rounds, which re-mask validity mid-program, re-derive their
+     own runs); the host supplies only the static run-capacity bound RC
+     (`plan_runs` — masking pods can merge or shrink runs, never split
+     them, so the unmasked host count bounds every gang round);
+  2. per run, the class's expensive row CONTEXT — static lattice gathers,
+     inter-pod affinity/anti-affinity, hard spread, the count-aggregated
+     score components — is evaluated ONCE (ops/assign.py mask_context_row /
+     score_context_row). This is sound for SELF-INTERACTION-FREE classes:
+     classes none of whose read terms match the class itself (and that hold
+     no anti-term/symmetric-weight on a term matching themselves), so their
+     own placements move state only at the placed node, through the cheap
+     dynamic components (resources, ports, volumes);
+  3. the run's replicas are placed by a capacity waterfill over admission
+     EPOCHS: each epoch sorts the live per-node head scores (score desc,
+     node index asc — the argmax tie-break) and admits the longest prefix
+     of distinct nodes that provably reproduces the per-pod argmax chain —
+     position i+1 admits only if its head beats the running argmax of the
+     already-admitted nodes' POST-placement heads, both sides computed by
+     the exact shared expression tree (score_combine_row /
+     mask_dynamic_row) the scan itself evaluates, so every rounding is
+     identical and the admitted sequence is bit-equal to the scan's. The
+     per-node admission capacity (min over resources of ⌊free/req⌋, the
+     port/volume self-conflict clamp to one replica per node) enters
+     through the same recomputed dynamic mask, not a parallel formula;
+  4. runs whose class self-interacts (self-anti-affinity, self-matching
+     affinity/spread/spread-selector terms, symmetric weight on a
+     self-matching term) and runs pinned by spec.nodeName fall back to a
+     per-pod inner loop executing the scan's exact step body
+     (assign_step) — correctness never depends on the closed form firing.
+
+Placements are BIT-EQUAL to assign_batch by construction — this is a pure
+execution-schedule optimization with the same sequential assume semantics;
+the serial chain shrinks from P steps to (#runs) steps plus cheap
+per-epoch work (tests/test_runs.py enforces equality across golden, gang,
+preemption, and mesh paths; docs/PERF.md round 8 has the scan-length math).
+
+One documented state-representation nit: for classes with all-zero
+symmetric weights the scan still ADDS 0.0 into WSYM per placement (which
+canonicalizes a -0.0 cell to +0.0); this engine skips the no-op adds.
+Score arithmetic and comparisons are sign-of-zero-blind there, so
+placements are unaffected — only the WSYM plane can differ in the sign of
+zeros.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..state.arrays import Array, ClusterTables, PodArrays
+from ..state.dims import bucket
+from .assign import (
+    AssignResult,
+    AssignState,
+    assign_step,
+    mask_context_row,
+    mask_dynamic_row,
+    queue_order,
+    score_combine_row,
+    score_context_row,
+)
+
+# floor for the bucketed run capacity: keeps the compile-signature count low
+# when tiny batches produce a handful of runs
+RC_MIN = 16
+
+
+class RunPlan(NamedTuple):
+    """Host-side sizing of one run-collapsed dispatch. Only `rc` (the
+    bucketed static scan length) enters the compiled program; the rest is
+    telemetry (CycleStats.class_runs / collapse_ratio). Emitted alongside
+    the pending arrays by the snapshot (state/cache.py) — pure host
+    metadata, so snapshots stay patch-compatible."""
+
+    rc: int       # static run-axis capacity (bucketed, ≥ n_runs)
+    n_runs: int   # actual class runs in the unmasked wave
+    n_valid: int  # valid pending pods covered by those runs
+
+    @property
+    def collapse_ratio(self) -> float:
+        """Scan-step reduction vs the per-pod engine: P_valid / runs."""
+        return self.n_valid / max(self.n_runs, 1)
+
+
+def plan_runs(cls, priority, creation, valid, node_name_req) -> RunPlan:
+    """Count the class runs of the queue-ordered wave on the HOST (numpy
+    over the staging columns — no device readback on the cache path) and
+    bucket the count into the static scan length. The sort replicates
+    queue_order exactly — including int32 negation wraparound on
+    INT32_MIN priorities — so the host count matches the device RLE;
+    runtime re-masking (gang rejection rounds) can only merge or shrink
+    runs, so this is an upper bound for every round of the dispatch."""
+    cls = np.asarray(cls)
+    valid = np.asarray(valid).astype(bool)
+    nnr = np.asarray(node_name_req)
+    negpri = (-(np.asarray(priority).astype(np.int64))).astype(np.int32)
+    order = np.lexsort((np.asarray(creation), negpri, ~valid))
+    v = valid[order]
+    n_valid = int(v.sum())
+    if n_valid == 0:
+        return RunPlan(rc=RC_MIN, n_runs=0, n_valid=0)
+    c = cls[order][:n_valid]
+    nn = nnr[order][:n_valid]
+    brk = np.ones((n_valid,), bool)
+    brk[1:] = (c[1:] != c[:-1]) | (nn[1:] != nn[:-1])
+    n_runs = int(brk.sum())
+    return RunPlan(rc=bucket(n_runs, minimum=RC_MIN),
+                   n_runs=n_runs, n_valid=n_valid)
+
+
+def self_interaction_vector(tables: ClusterTables, cyc) -> Array:
+    """[SC] bool: classes whose own placements can feed back into their own
+    Filter/Score rows — through a read term that matches the class itself
+    (required/anti affinity, preferred affinity/anti, topology spread,
+    SelectorSpread owners), or through an anti-term/symmetric-weight the
+    class WRITES on a term that matches it. Such runs take the per-pod
+    fallback; everything else gets the closed-form waterfill."""
+    classes = tables.classes
+    TM = cyc.TM  # [S, SC]
+    SC = classes.valid.shape[0]
+    cid = jnp.arange(SC, dtype=jnp.int32)
+
+    def own_hit(ids: Array) -> Array:  # [SC, A] term slots → [SC]
+        safe = jnp.maximum(ids, 0)
+        hit = TM[safe, cid[:, None]] & (ids >= 0)
+        return hit.any(axis=1)
+
+    reads_self = (
+        own_hit(classes.aff_terms) | own_hit(classes.anti_terms)
+        | own_hit(classes.paff_terms) | own_hit(classes.panti_terms)
+        | own_hit(classes.tsc_term) | own_hit(classes.ssel_terms)
+    )
+    # writes on a term matching me: HOLD via my anti membership, WSYM via
+    # my symmetric weight column — both read back by my own row through
+    # blocked_sym / sym_affinity_contrib
+    matches_me = TM.T  # [SC, S]
+    writes_self = (
+        matches_me & (cyc.has_anti | (cyc.WCOLS.T != 0.0))
+    ).any(axis=1)
+    return reads_self | writes_self
+
+
+def _encode_runs(pods: PodArrays, rc: int):
+    """Device-side run-length encoding of the queue-ordered wave: maximal
+    stretches of consecutive (class, nodeName-requirement)-identical VALID
+    pods. Invalid pods sort last (queue_order's primary key), so the valid
+    region is a prefix and every run is contiguous in sorted order."""
+    P = pods.valid.shape[0]
+    order = queue_order(pods)
+    valid_s = pods.valid[order]
+    cls_s = jnp.where(valid_s, pods.cls[order], -1)
+    nnr_s = pods.node_name_req[order]
+    pos = jnp.arange(P, dtype=jnp.int32)
+    prev_cls = jnp.concatenate([jnp.full((1,), -2, jnp.int32), cls_s[:-1]])
+    prev_nnr = jnp.concatenate([jnp.full((1,), -2, jnp.int32), nnr_s[:-1]])
+    newrun = valid_s & ((cls_s != prev_cls) | (nnr_s != prev_nnr))
+    rid = jnp.cumsum(newrun.astype(jnp.int32)) - 1
+    rid = jnp.where(valid_s, rid, rc)  # discard slot for invalid pods
+    run_len = jnp.zeros((rc,), jnp.int32).at[rid].add(1, mode="drop")
+    run_start = jnp.full((rc,), P, jnp.int32).at[rid].min(pos, mode="drop")
+    run_cls = jnp.zeros((rc,), jnp.int32).at[rid].max(
+        jnp.maximum(cls_s, 0), mode="drop")
+    run_nnr = jnp.full((rc,), -1, jnp.int32).at[rid].max(nnr_s, mode="drop")
+    n_runs = newrun.sum()
+    return order, run_start, run_len, run_cls, run_nnr, n_runs
+
+
+def _perpod_run(tables, cyc, pods, state, node_out, order, k, start):
+    """Fallback: the run's pods one at a time through the scan's exact step
+    body — self-interacting classes and nodeName-pinned runs, where the
+    closed form's frozen context would be unsound."""
+    P = pods.valid.shape[0]
+
+    def body(t, carry):
+        state, node_out = carry
+        idx = order[jnp.minimum(start + t, P - 1)]
+        state, node, _feas = assign_step(
+            tables, cyc, state, pods.cls[idx], pods.valid[idx],
+            pods.node_name_req[idx])
+        node_out = node_out.at[idx].set(node)
+        return (state, node_out)
+
+    return lax.fori_loop(0, k, body, (state, node_out))
+
+
+def _closed_run(tables, cyc, pods, state, node_out, order, c, k, start):
+    """The run-collapsed waterfill for one self-interaction-free run of `k`
+    replicas of class `c`: admission epochs over the exact per-node head
+    scores (module docstring, step 3). All float values flow through the
+    SAME expression tree the scan evaluates (score_combine_row /
+    mask_dynamic_row on synthesized per-node planes), so the admitted
+    node sequence is bit-equal to the per-pod argmax chain."""
+    classes = tables.classes
+    nodes = tables.nodes
+    N = nodes.valid.shape[0]
+    P = pods.valid.shape[0]
+    req_vec = tables.reqs.vec[classes.rid[c]]  # [R]
+    ps = classes.portset[c]
+    psafe = jnp.maximum(ps, 0)
+    live_ps = ps >= 0
+    pw = jnp.where(live_ps, tables.portsets.pair_words[psafe], 0)
+    ww = jnp.where(live_ps, tables.portsets.wild_words[psafe], 0)
+    tw = jnp.where(live_ps, tables.portsets.trip_words[psafe], 0)
+    vs = classes.volset[c]
+    vsafe = jnp.maximum(vs, 0)
+    live_vs = vs >= 0
+    va = jnp.where(live_vs, tables.volsets.any_words[vsafe], 0)
+    vr = jnp.where(live_vs, tables.volsets.rw_words[vsafe], 0)
+
+    # frozen per-run context: one expensive row evaluation per RUN (this is
+    # the collapse — the scan pays these gathers per POD)
+    ctx_mask = mask_context_row(tables, cyc, state, c, jnp.int32(-1), k > 0)
+    sctx = score_context_row(tables, cyc, state, c)
+    w_vec = cyc.WCOLS[:, c]  # [S] — zero for classes without preferences
+
+    def words(base, own, placed):
+        # node n's port/volume plane after its own placements: idempotent
+        # OR, so one placement and j placements synthesize identically
+        return base | jnp.where(placed[:, None], own[None, :], 0)
+
+    def row_at(j, placed):
+        """(mask, score) each [N]: the class's NEXT replica's row when node
+        n already took j[n] replicas this run — exactly what the scan
+        recomputes per pod, vectorized over nodes."""
+        used_j = state.used + j[:, None] * req_vec[None, :]
+        dyn = mask_dynamic_row(
+            tables, cyc, c, used_j,
+            words(state.ppa, pw, placed), words(state.ppw, ww, placed),
+            words(state.ppt, tw, placed),
+            words(state.vol_any, va, placed), words(state.vol_rw, vr, placed))
+        m = ctx_mask & dyn
+        s = score_combine_row(tables, cyc, c, used_j, sctx)
+        return m, jnp.where(m, s, -jnp.inf)
+
+    iota_n = jnp.arange(N, dtype=jnp.int32)
+
+    def epoch(carry):
+        j, remaining, consumed, node_out, _alive = carry
+        placed = j > 0
+        _m, cur = row_at(j, placed)
+        _mp, plus = row_at(j + 1, jnp.ones_like(placed))
+        ordn = jnp.argsort(-cur, stable=True)  # ties → lowest node index
+        e = cur[ordn]    # head score of the i-th best node
+        ep = plus[ordn]  # that node's head AFTER it admits one replica
+        # running argmax (value desc, node index asc on ties) over the
+        # POST-placement heads of the prefix — what the scan's argmax sees
+        # from the nodes already admitted this epoch
+
+        def comb(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = (bv > av) | ((bv == av) & (bi < ai))
+            return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+        Mv, Mi = lax.associative_scan(comb, (ep, ordn))
+        prevMv = jnp.concatenate(
+            [jnp.full((1,), -jnp.inf, cur.dtype), Mv[:-1]])
+        prevMi = jnp.concatenate([jnp.full((1,), N, jnp.int32), Mi[:-1]])
+        beats = (e > prevMv) | ((e == prevMv) & (ordn < prevMi))
+        okpos = beats & (e != -jnp.inf) & (iota_n < remaining)
+        T = jnp.cumprod(okpos.astype(jnp.int32)).sum()
+        take = iota_n < T
+        # replica (consumed + i) of the run → node ordn[i], i < T — the
+        # scan's per-pod sequence for this stretch
+        sp = start + consumed + iota_n
+        pid = jnp.where(take, order[jnp.minimum(sp, P - 1)], P)
+        node_out = node_out.at[pid].set(ordn)
+        placed_now = jnp.zeros((N,), bool).at[ordn].set(take)
+        j = j + placed_now.astype(jnp.int32)
+        return (j, remaining - T, consumed + T, node_out, T > 0)
+
+    def cond(carry):
+        _j, remaining, _consumed, _no, alive = carry
+        return (remaining > 0) & alive
+
+    j0 = jnp.zeros((N,), jnp.int32)
+    j, _rem, _cons, node_out, _alive = lax.while_loop(
+        cond, epoch, (j0, k, jnp.int32(0), node_out, k > 0))
+
+    # ---- commit the whole run to the carry (int/bitset closed forms are
+    # exact; the WSYM float column replays the scan's per-placement add
+    # chain so later runs see bit-identical weights) ----
+    placed = j > 0
+    used_f = state.used + j[:, None] * req_vec[None, :]
+    ppa_f = words(state.ppa, pw, placed)
+    ppw_f = words(state.ppw, ww, placed)
+    ppt_f = words(state.ppt, tw, placed)
+    vol_any_f = words(state.vol_any, va, placed)
+    vol_rw_f = words(state.vol_rw, vr, placed)
+    CNT_f = state.CNT + cyc.TM[:, c].astype(jnp.int32)[:, None] * j[None, :]
+    HOLD_f = state.HOLD \
+        + cyc.has_anti[c].astype(jnp.int32)[:, None] * j[None, :]
+
+    def wsym_chain(W):
+        # fl(x+w) applied j[n] times per column — the scan's exact rounding
+        # sequence (j·w in one multiply would round differently)
+        def add_round(carry):
+            W, t = carry
+            W = W + jnp.where((j > t)[None, :], w_vec[:, None], 0.0)
+            return (W, t + 1)
+
+        maxj = jnp.max(j)
+        return lax.while_loop(lambda carry: carry[1] < maxj,
+                              add_round, (W, jnp.int32(0)))[0]
+
+    WSYM_f = lax.cond((w_vec != 0.0).any(), wsym_chain,
+                      lambda W: W, state.WSYM)
+
+    state_f = AssignState(
+        used=used_f, ppa=ppa_f, ppw=ppw_f, ppt=ppt_f,
+        CNT=CNT_f, HOLD=HOLD_f, WSYM=WSYM_f,
+        vol_any=vol_any_f, vol_rw=vol_rw_f)
+    return (state_f, node_out)
+
+
+def assign_runs(
+    tables: ClusterTables,
+    cyc,
+    pods: PodArrays,
+    init: AssignState,
+    rc: int,
+) -> AssignResult:
+    """Drop-in engine with assign_batch's signature plus the static run
+    capacity `rc` (host-computed bound, plan_runs). Placements are bit-equal
+    to the per-pod scan; the serial chain is one step per RUN."""
+    P = pods.valid.shape[0]
+    rc = int(rc)
+    order, run_start, run_len, run_cls, run_nnr, n_runs = _encode_runs(
+        pods, rc)
+    selfi = self_interaction_vector(tables, cyc)
+
+    def run_step(carry, r):
+        state, node_out = carry
+        active = r < n_runs
+        c = run_cls[r]
+        k = jnp.where(active, run_len[r], 0)
+        start = run_start[r]
+        nnr = run_nnr[r]
+        closed_ok = ~selfi[c] & (nnr < 0)
+        state, node_out = lax.cond(
+            closed_ok,
+            lambda s, no: _closed_run(tables, cyc, pods, s, no, order,
+                                      c, k, start),
+            lambda s, no: _perpod_run(tables, cyc, pods, s, no, order,
+                                      k, start),
+            state, node_out)
+        return (state, node_out), None
+
+    node_out0 = jnp.full((P + 1,), -1, jnp.int32)
+    (final, node_out), _ = lax.scan(
+        run_step, (init, node_out0), jnp.arange(rc, dtype=jnp.int32))
+    node = node_out[:P]
+    return AssignResult(node=node, feasible=node >= 0, state=final)

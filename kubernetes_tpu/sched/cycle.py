@@ -67,12 +67,19 @@ def snapshot_with_keys(cache, encoder: Encoder, pending, base_dims,
 
 def _engine() -> str:
     """Assignment engine: 'waves' (default — wave-parallel dense admission,
-    ops/waves.py) or 'scan' (the literal sequential-assume lax.scan,
-    ops/assign.py; KTPU_ASSIGN=scan) kept for debugging and as the
-    executable spec the wave path is tested against."""
+    ops/waves.py), 'runs' (run-length-collapsed sequential admission,
+    ops/runs.py; KTPU_ASSIGN=runs — bit-equal to the scan with the serial
+    chain shrunk from P pod-steps to #class-runs steps), or 'scan' (the
+    literal sequential-assume lax.scan, ops/assign.py; KTPU_ASSIGN=scan)
+    kept for debugging and as the executable spec both other engines are
+    tested against. Unrecognized KTPU_ASSIGN values normalize to 'waves':
+    downstream routing keys on exact engine names (e.g. nodeName-bearing
+    batches reroute 'waves' to the scan), so a typo must land on a known
+    engine, not fall through the dispatch untyped."""
     import os
 
-    return os.environ.get("KTPU_ASSIGN", "waves")
+    eng = os.environ.get("KTPU_ASSIGN", "waves")
+    return eng if eng in ("waves", "runs", "scan") else "waves"
 
 
 def _apply_extra_plugins(tables, cyc, extra_plugins, extra_weights):
@@ -106,7 +113,7 @@ def _apply_extra_plugins(tables, cyc, extra_plugins, extra_weights):
         score=cyc.static.score + bias))
 
 
-@functools.partial(jax.jit, static_argnums=(3, 5, 8, 11))
+@functools.partial(jax.jit, static_argnums=(3, 5, 8, 11, 12))
 def _schedule_batch_impl(
     tables: ClusterTables,
     pending: PodArrays,
@@ -120,27 +127,36 @@ def _schedule_batch_impl(
     extra_weights: tuple = (),
     gang=None,
     return_waves: bool = False,
+    rc: int = 0,
 ):
     from ..ops.gang import assign_gang
+    from ..ops.runs import assign_runs
     from ..ops.waves import assign_waves
 
     uk, ev = keys
     cyc = build_cycle(tables, existing, uk, ev, D, hard_weight, ecfg)
     cyc = _apply_extra_plugins(tables, cyc, extra_plugins, extra_weights)
     init = initial_state(tables, cyc)
+    # `rc` is the run-collapsed engine's static run capacity (ops/runs.py
+    # plan_runs); it also bounds every gang rejection round's run count
+    # (masking merges/shrinks runs, never splits them)
+    runs_fn = (lambda t, cy, pe, ini: assign_runs(t, cy, pe, ini, rc))
     if gang is not None:
         # group-atomic admission (ops/gang.py); gang=None traces the plain
         # engines, so gang-free batches compile/run exactly as before
-        if return_waves and engine != "scan":
+        if return_waves and engine == "waves":
             res, _, waves = assign_gang(tables, cyc, pending, init, gang,
                                         return_waves=True)
             return res, waves
+        engine_fn = {"scan": assign_batch, "runs": runs_fn}.get(engine)
         res, _ = assign_gang(
-            tables, cyc, pending, init, gang,
-            engine_fn=assign_batch if engine == "scan" else None)
+            tables, cyc, pending, init, gang, engine_fn=engine_fn)
         return (res, None) if return_waves else res
     if engine == "scan":
         res = assign_batch(tables, cyc, pending, init)
+        return (res, None) if return_waves else res
+    if engine == "runs":
+        res = runs_fn(tables, cyc, pending, init)
         return (res, None) if return_waves else res
     if return_waves:
         # bench/profiling: per-pod admission-wave indices ride along so the
@@ -230,6 +246,23 @@ def _schedule_gang_host_rounds(tables, pending, keys, D, existing,
     return res, waves
 
 
+def _resolve_rc(pending, runs):
+    """The run-collapsed engine's static scan length: the snapshot-supplied
+    RunPlan when the cache emitted one (no readback), else derived from the
+    pending arrays (tests/bench calling the dispatch layer directly — one
+    [P]-column readback, off the serving hot path)."""
+    from ..ops.runs import plan_runs
+
+    if runs is not None:
+        return runs.rc
+    import numpy as np
+
+    return plan_runs(
+        np.asarray(pending.cls), np.asarray(pending.priority),
+        np.asarray(pending.creation), np.asarray(pending.valid),
+        np.asarray(pending.node_name_req)).rc
+
+
 def _schedule_batch(tables, pending, keys, D, existing,
                     has_node_name: bool = False,
                     hard_weight: float = 1.0,
@@ -240,22 +273,26 @@ def _schedule_batch(tables, pending, keys, D, existing,
                     return_waves: bool = False,
                     dims=None,
                     prewarmer=None,
-                    mesh=None):
+                    mesh=None,
+                    runs=None):
     engine = _engine()
-    if gang is not None and engine != "scan" and not has_node_name \
+    if gang is not None and engine == "waves" and not has_node_name \
             and pending.valid.shape[0] >= _GANG_HOST_THRESHOLD:
         out = _schedule_gang_host_rounds(
             tables, pending, keys, D, existing, hard_weight, ecfg,
             extra_plugins, extra_weights, gang)
         return out if return_waves else out[0]
-    if engine != "scan" and has_node_name:
+    if engine == "waves" and has_node_name:
         # spec.nodeName pods carry a per-POD (not per-class) host constraint
         # the class-granular wave path cannot express; in the reference such
         # pods bypass the scheduler entirely (kubelet consumes them), so a
         # batch containing one is rare — route it through the literal scan.
-        # The flag comes from Dims (computed host-side at encode time) so the
-        # hot path never blocks on a device readback before dispatch.
+        # (The runs engine splits runs on nodeName and falls back per-pod
+        # for pinned stretches, so it keeps such batches.) The flag comes
+        # from Dims (computed host-side at encode time) so the hot path
+        # never blocks on a device readback before dispatch.
         engine = "scan"
+    rc = _resolve_rc(pending, runs) if engine == "runs" else 0
     # hardPodAffinitySymmetricWeight (apis/config/types.go:70) and the
     # EngineConfig plugin composition ride as traced f32 scalars so config
     # changes never recompile
@@ -272,8 +309,10 @@ def _schedule_batch(tables, pending, keys, D, existing,
         # single-device one at the same Dims are different executables, and
         # invoking one with the other's arrays would silently reshard onto
         # (possibly dead) devices — lookup isolation makes that impossible.
+        # The run capacity rc is part of the key for the same reason: a
+        # different run bucket is a different compiled program.
         compiled = prewarmer.lookup(dims, engine, extra_plugins,
-                                    gang is not None, mesh=mesh)
+                                    gang is not None, mesh=mesh, rc=rc)
         if compiled is not None:
             try:
                 return compiled(tables, pending, keys, existing, hw, ecfg,
@@ -283,7 +322,7 @@ def _schedule_batch(tables, pending, keys, D, existing,
     return _schedule_batch_impl(tables, pending, keys, D, existing, engine,
                                 hw, ecfg,
                                 extra_plugins, extra_weights, gang,
-                                return_waves)
+                                return_waves, rc)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))

@@ -189,7 +189,9 @@ class BucketPrewarmer:
         self._inflight_preempt: Optional[threading.Thread] = None
         self._compile_fn = compile_fn or self._compile
         self.warm_log: list = []   # (dims, engine) actually compiled — tests
-        # (dims, engine, extras, gang) → jax Compiled for the cycle program;
+        # (dims, engine, extras, gang, rc, mesh sig) → jax Compiled for the
+        # cycle program (rc = the run-collapsed engine's static run
+        # capacity, 0 for the other engines);
         # ("preempt", dims, burst) → Compiled for the preemption burst
         self.compiled: dict = {}
         # bumped by invalidate(): a background compile that STARTED before a
@@ -210,7 +212,7 @@ class BucketPrewarmer:
 
     def observe(self, d: Dims, n_nodes: int, n_existing: int,
                 engine: str = "waves", extras: tuple = (),
-                gang: bool = False, mesh=None) -> None:
+                gang: bool = False, mesh=None, rc: int = 0) -> None:
         """Call once per cycle with live occupancy (and whether batches are
         gang-bearing — gangs trace a different program; and which mesh the
         cycle dispatches on — a sharded program is a different executable).
@@ -235,7 +237,7 @@ class BucketPrewarmer:
             if target == d:
                 continue
             key = (replace(target, has_node_name=False), engine, extras,
-                   gang, msig)
+                   gang, rc, msig)
             with self._mu:
                 if key in self._warmed:
                     continue
@@ -244,7 +246,7 @@ class BucketPrewarmer:
                 self._warmed.add(key)
                 t = threading.Thread(
                     target=self._compile_fn,
-                    args=(target, engine, extras, gang, mesh),
+                    args=(target, engine, extras, gang, mesh, rc),
                     name=f"ktpu-prewarm-{target.N}x{target.E}", daemon=True)
                 # start BEFORE publishing: wait() joins _inflight without
                 # the lock, and joining a not-yet-started thread raises
@@ -253,9 +255,9 @@ class BucketPrewarmer:
             return
 
     def _compile(self, d: Dims, engine: str, extras: tuple,
-                 gang: bool, mesh=None) -> None:
+                 gang: bool, mesh=None, rc: int = 0) -> None:
         key = (replace(d, has_node_name=False), engine, extras, gang,
-               self._mesh_sig(mesh))
+               rc, self._mesh_sig(mesh))
         epoch = self._epoch
         try:
             from ..utils import faultline
@@ -270,6 +272,7 @@ class BucketPrewarmer:
             compiled = _schedule_batch_impl.lower(
                 tables, pending, keys, d.D, existing, engine, hw, ecfg,
                 extras, tuple(1.0 for _ in extras), gang_args,
+                False, rc,
             ).compile()
             with self._mu:
                 if epoch != self._epoch:
@@ -291,7 +294,7 @@ class BucketPrewarmer:
                 self.supervisor.note_compile_failure(e)
 
     def lookup(self, d: Dims, engine: str, extras: tuple, gang: bool,
-               mesh=None):
+               mesh=None, rc: int = 0):
         """The stored Compiled for this cycle signature, or None. Called on
         the dispatch hot path — one dict probe. The mesh signature is part
         of the key, so a single-device caller can NEVER receive a
@@ -299,7 +302,7 @@ class BucketPrewarmer:
         a degraded wave from resharding its arrays onto lost devices."""
         return self.compiled.get(
             (replace(d, has_node_name=False), engine, extras, gang,
-             self._mesh_sig(mesh)))
+             rc, self._mesh_sig(mesh)))
 
     def invalidate(self) -> None:
         """Drop every stored executable and warm record, and fence out
@@ -313,7 +316,7 @@ class BucketPrewarmer:
             self._warmed.clear()
 
     def rewarm(self, d: Dims, engine: str = "waves", extras: tuple = (),
-               gang: bool = False, mesh=None) -> bool:
+               gang: bool = False, mesh=None, rc: int = 0) -> bool:
         """Force a background compile of the CURRENT dims regardless of
         occupancy thresholds — the backend re-admission path: the recovered
         device's first wave should deserialize a warm executable, not pay a
@@ -328,14 +331,14 @@ class BucketPrewarmer:
         if max(d.N, d.E) < self.min_axis:
             return False  # small shapes recompile in seconds on demand
         key = (replace(d, has_node_name=False), engine, extras, gang,
-               self._mesh_sig(mesh))
+               rc, self._mesh_sig(mesh))
         with self._mu:
             self._warmed.add(key)
             prev = self._inflight
             if prev is not None and prev.is_alive():
                 def chained():
                     prev.join()
-                    self._compile_fn(d, engine, extras, gang, mesh)
+                    self._compile_fn(d, engine, extras, gang, mesh, rc)
 
                 t = threading.Thread(
                     target=chained,
@@ -343,7 +346,7 @@ class BucketPrewarmer:
             else:
                 t = threading.Thread(
                     target=self._compile_fn,
-                    args=(d, engine, extras, gang, mesh),
+                    args=(d, engine, extras, gang, mesh, rc),
                     name=f"ktpu-rewarm-{d.N}x{d.E}", daemon=True)
             # start BEFORE publishing (wait() joins without the lock; a
             # not-yet-started thread would raise there). rewarm runs on the
@@ -353,7 +356,7 @@ class BucketPrewarmer:
         return True
 
     def ensure_warm(self, d: Dims, engine: str = "waves", extras: tuple = (),
-                    gang: bool = False, mesh=None) -> bool:
+                    gang: bool = False, mesh=None, rc: int = 0) -> bool:
         """The warm-standby beat (Scheduler.warm_standby): compile this
         exact signature in the background IF it is neither compiled nor
         already compiling — idempotent, unlike rewarm (which always
@@ -362,13 +365,13 @@ class BucketPrewarmer:
         if not self.enabled or max(d.N, d.E) < self.min_axis:
             return False
         key = (replace(d, has_node_name=False), engine, extras, gang,
-               self._mesh_sig(mesh))
+               rc, self._mesh_sig(mesh))
         with self._mu:
             # _warmed covers both finished compiles (the key stays) and
             # in-flight ones (added before the thread starts)
             if key in self._warmed:
                 return False
-        return self.rewarm(d, engine, extras, gang, mesh)
+        return self.rewarm(d, engine, extras, gang, mesh, rc)
 
     # ---- preemption-burst program (sched/preemption.py _preempt) ---- #
 

@@ -68,6 +68,11 @@ class CycleStats:
     # pods whose wave dispatch was abandoned (primary AND fallback failed):
     # requeued promptly with attempts preserved — not failures of the pods
     aborted: int = 0
+    # run-collapsed engine telemetry (ops/runs.py, KTPU_ASSIGN=runs): how
+    # many class runs the queue-ordered wave factored into, and the
+    # scan-step reduction P_valid/runs the collapse bought this wave
+    class_runs: int = 0
+    collapse_ratio: float = 0.0
     cycle_seconds: float = 0.0
     assignments: Dict[str, str] = field(default_factory=dict)
     # pod keys that failed this wave (feeds FailedScheduling events)
@@ -315,17 +320,27 @@ class Scheduler:
 
         from .cycle import _engine
 
-        wave_engine = "scan" if snap.dims.has_node_name else _engine()
+        eng = _engine()
+        # nodeName-bearing batches reroute the wave engine to the literal
+        # scan; the runs engine keeps them (it splits runs on nodeName and
+        # falls back per-pod for pinned stretches)
+        wave_engine = "scan" if (snap.dims.has_node_name
+                                 and eng == "waves") else eng
         gang_arg = snap.gang if self._device_gangs else None
+        rc = 0
+        if wave_engine == "runs" and snap.runs is not None:
+            rc = snap.runs.rc
+            stats.class_runs = snap.runs.n_runs
+            stats.collapse_ratio = round(snap.runs.collapse_ratio, 2)
         self.prewarmer.observe(
             snap.dims, n_nodes=self.cache.node_count,
             n_existing=self.cache.pod_count,
             engine=wave_engine,
             extras=extras,
             gang=self._device_gangs and snap.gang is not None,
-            mesh=snap.mesh)
+            mesh=snap.mesh, rc=rc)
         self.supervisor.note_cycle_signature(
-            snap.dims, wave_engine, extras, gang_arg is not None)
+            snap.dims, wave_engine, extras, gang_arg is not None, rc=rc)
 
         def _primary():
             res = _schedule_batch(
@@ -335,7 +350,7 @@ class Scheduler:
                 ecfg=self.engine_config,
                 extra_plugins=extras, extra_weights=extra_w,
                 gang=gang_arg, dims=snap.dims, prewarmer=self.prewarmer,
-                mesh=snap.mesh)
+                mesh=snap.mesh, runs=snap.runs)
             return jax.device_get(res.node)
 
         # the commit loop must map node indices through the node_order of
@@ -357,6 +372,7 @@ class Scheduler:
             # from. No prewarmer — its executables belong to the primary.
             tb = None
             dd = snap.dims
+            rn = snap.runs
             if not hung:
                 try:
                     tb, pe, ex, ky, gg = jax.device_put(
@@ -371,6 +387,7 @@ class Scheduler:
                 tb, pe, ex, ky, dd = (fsnap.tables, fsnap.pending,
                                       fsnap.existing, fkeys, fsnap.dims)
                 gg = fsnap.gang if self._device_gangs else None
+                rn = fsnap.runs
                 wave_ctx["node_order"] = fsnap.node_order
             with jax.default_device(dev):
                 res = _schedule_batch(
@@ -379,7 +396,7 @@ class Scheduler:
                     hard_weight=self.hard_pod_affinity_weight,
                     ecfg=self.engine_config,
                     extra_plugins=extras, extra_weights=extra_w,
-                    gang=gg)
+                    gang=gg, runs=rn)
                 return jax.device_get(res.node)
 
         # the budget key carries the PROGRAM signature, not just the shape:
@@ -402,7 +419,7 @@ class Scheduler:
             handle = self.supervisor.submit(
                 "cycle",
                 (_dc_replace(snap.dims, has_node_name=False), wave_engine,
-                 extras, gang_arg is not None, _mesh_key(snap.mesh)),
+                 extras, gang_arg is not None, _mesh_key(snap.mesh), rc),
                 _primary, _fallback)
             # ---- double-buffered host/device overlap: the dispatch above
             # runs on the watchdog worker, so while the device evaluates
@@ -793,18 +810,23 @@ class Scheduler:
         snap, _keys = self._snapshot_keys(backlog)
         from .cycle import _engine
 
-        wave_engine = "scan" if snap.dims.has_node_name else _engine()
+        eng = _engine()
+        wave_engine = "scan" if (snap.dims.has_node_name
+                                 and eng == "waves") else eng
         extras = tuple(p for p, _ in self._extra_score)
         gang = self._device_gangs and snap.gang is not None
+        rc = snap.runs.rc if (wave_engine == "runs"
+                              and snap.runs is not None) else 0
         # compile the signature the first led wave WILL dispatch (idempotent
         # per signature), and keep the growth-boundary lookahead running so
         # a takeover into a growing cluster doesn't stall either
         self.prewarmer.ensure_warm(snap.dims, wave_engine, extras, gang,
-                                   mesh=snap.mesh)
+                                   mesh=snap.mesh, rc=rc)
         self.prewarmer.observe(
             snap.dims, n_nodes=self.cache.node_count,
             n_existing=self.cache.pod_count,
-            engine=wave_engine, extras=extras, gang=gang, mesh=snap.mesh)
+            engine=wave_engine, extras=extras, gang=gang, mesh=snap.mesh,
+            rc=rc)
 
     # ------------------------------------------------------------------ #
     # commit path: assume → Reserve → Permit → PreBind → Bind → PostBind
@@ -970,6 +992,10 @@ class Scheduler:
             total.unschedulable += s.unschedulable
             total.bind_errors += s.bind_errors
             total.aborted += s.aborted
+            if s.class_runs:
+                # run-collapse telemetry: keep the last non-empty wave's
+                total.class_runs = s.class_runs
+                total.collapse_ratio = s.collapse_ratio
             total.assignments.update(s.assignments)
             if self.queue.lengths()[0] == 0:
                 break

@@ -227,12 +227,12 @@ class DispatchSupervisor:
         return self.mesh_state.mesh
 
     def note_cycle_signature(self, dims, engine: str, extras: tuple,
-                             gang: bool) -> None:
+                             gang: bool, rc: int = 0) -> None:
         """Remember what the live cycle program looks like so re-admission
         can warm exactly it (the mesh itself is NOT part of the note: the
         rewarm targets whatever mesh exists post-reform, never the dead
         one's signature)."""
-        self._cycle_sig = (dims, engine, extras, gang)
+        self._cycle_sig = (dims, engine, extras, gang, rc)
 
     def _mark_unhealthy(self, reason: str) -> None:
         with self._mu:
@@ -373,10 +373,10 @@ class DispatchSupervisor:
             except Exception:  # noqa: BLE001 - single-device serving is
                 mesh = None    # always a legal landing spot
         if self.prewarmer is not None and sig is not None:
-            dims, engine, extras, gang = sig
+            dims, engine, extras, gang, rc = sig
             try:
                 if self.prewarmer.rewarm(dims, engine=engine, extras=extras,
-                                         gang=gang, mesh=mesh):
+                                         gang=gang, mesh=mesh, rc=rc):
                     self.stats.rewarms += 1
             except Exception:  # noqa: BLE001 - rewarm is an optimization
                 pass

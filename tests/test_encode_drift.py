@@ -1,0 +1,142 @@
+"""Drift guard for the class-fingerprint fast path (state/encode.py).
+
+`Encoder.intern_pods` INLINES `class_fingerprint` for ingest throughput (the
+"KEEP IN SYNC" comment marks the copy). Until now only that comment enforced
+the sync; a drifted field (one tuple entry added to the method but not the
+loop, or vice versa) would silently split or MERGE equivalence classes —
+merged classes schedule with the wrong spec. These tests make the sync
+executable: over the golden randomized pod corpus (plus the edge shapes the
+fingerprint special-cases), the method-based memo path (class_id_memo, used
+by pod_row) and the inlined loop must produce IDENTICAL fingerprint keys and
+IDENTICAL class assignments.
+"""
+
+import dataclasses
+import random
+
+from kubernetes_tpu.api.types import Pod, Resources
+from kubernetes_tpu.state.encode import Encoder
+
+from test_golden import rand_pod
+
+
+def _intern_converged(enc, pods):
+    """intern_pods with the caller-side projection convergence loop every
+    real caller runs (encode_cluster / SchedulerCache.snapshot): a selector
+    referencing a new pod-label key mid-batch widens the projection and
+    invalidates earlier rows."""
+    for _ in range(8):
+        enc.intern_pods(pods)
+        if not enc.classes_stale:
+            return
+        enc.projection_rewalk()
+    raise AssertionError("projection did not converge")
+
+
+def _method_walk_converged(enc, pods):
+    """The per-pod (pod_row → class_id_memo) path under the same
+    convergence contract."""
+    for _ in range(8):
+        for p in pods:
+            enc.pod_row(p)
+        if not enc.classes_stale:
+            return
+        enc.projection_rewalk()
+    raise AssertionError("projection did not converge")
+
+
+def _corpus(n=160):
+    """Golden randomized pods + the fingerprint's special-cased shapes:
+    all-empty Affinity (collapses to None), limits set/unset, labels under
+    and outside the referenced projection, volumes, ports."""
+    rng = random.Random(20260803)
+    pods = [rand_pod(rng, i) for i in range(n)]
+    # replica bursts: identical templates as FRESH objects (the memo's
+    # actual hot path — identity memos miss, value fingerprints must hit)
+    for i in range(20):
+        t = rand_pod(rng, 1000 + i)
+        pods.extend(dataclasses.replace(t, name=f"r{i}-{k}",
+                                        creation_index=2000 + 10 * i + k)
+                    for k in range(3))
+    pods.append(Pod(name="lim", requests=Resources.make(cpu="100m"),
+                    limits=Resources.make(cpu="200m", memory="64Mi"),
+                    creation_index=5000))
+    pods.append(Pod(name="bare", creation_index=5001))
+    return pods
+
+
+def test_inlined_fingerprint_matches_method_over_golden_corpus():
+    """The inlined loop's memo keys must BE class_fingerprint's keys: after
+    intern_pods, re-deriving every pod's fingerprint through the METHOD
+    must hit the loop's memo entry and map to the same class id the loop
+    assigned. A drifted tuple shape misses the memo (KeyError here) or maps
+    elsewhere (class mismatch) — either fails loudly."""
+    pods = _corpus()
+    enc = Encoder()
+    _intern_converged(enc, pods)
+    for p in pods:
+        row_cls = enc.pod_row(p)[2]  # memoized by the inlined loop
+        ns_id = enc.vocabs.namespaces.intern(p.namespace)
+        fp = enc.class_fingerprint(p, ns_id)
+        assert fp in enc._class_memo, (
+            f"class_fingerprint({p.name}) produced a key the inlined "
+            f"intern_pods loop never built — the two are out of sync")
+        assert enc._class_memo[fp] == row_cls, (
+            f"{p.name}: method fingerprint maps to class "
+            f"{enc._class_memo[fp]}, inlined loop assigned {row_cls}")
+
+
+def test_method_walk_then_inlined_walk_creates_no_new_classes():
+    """The reverse direction: walking the corpus through the METHOD path
+    first (pod_row → class_id_memo → class_fingerprint), then through the
+    inlined loop on FRESH equal-valued objects, must intern zero new
+    classes and zero new memo keys — both paths bucket value-equal specs
+    identically."""
+    pods = _corpus()
+    clones = [dataclasses.replace(p) for p in pods]  # fresh identities
+    enc = Encoder()
+    _method_walk_converged(enc, pods)
+    n_classes = len(enc.class_reg)
+    n_keys = len(enc._class_memo)
+    enc.intern_pods(clones)  # inlined path over value-equal objects
+    assert not enc.classes_stale  # method walk already converged
+    assert len(enc.class_reg) == n_classes, (
+        "inlined fingerprint split classes the method path had merged")
+    assert len(enc._class_memo) == n_keys, (
+        "inlined fingerprint built keys the method never would")
+    for p, q in zip(pods, clones):
+        assert enc.pod_row(p)[2] == enc.pod_row(q)[2]
+
+
+def test_projection_widening_keeps_paths_in_sync():
+    """After a selector references a previously-unreferenced pod-label key
+    (projection widens, memos rewalk), both paths must still agree — the
+    label-projection subset is part of the fingerprint on BOTH sides."""
+    from kubernetes_tpu.api.types import (
+        Affinity, LabelSelector, PodAffinityTerm)
+
+    enc = Encoder()
+    a = Pod(name="a", labels={"team": "x", "junk": "1"},
+            requests=Resources.make(cpu="100m"), creation_index=0)
+    b = Pod(name="b", labels={"team": "y", "junk": "1"},
+            requests=Resources.make(cpu="100m"), creation_index=1)
+    enc.intern_pods([a, b])
+    # unreferenced labels project out: a and b share a class
+    assert enc.pod_row(a)[2] == enc.pod_row(b)[2]
+    ref = Pod(name="sel", requests=Resources.make(cpu="100m"),
+              affinity=Affinity(pod_required=(PodAffinityTerm(
+                  selector=LabelSelector.of(match_labels={"team": "x"}),
+                  topology_key="kubernetes.io/hostname"),)),
+              creation_index=2)
+    enc.intern_pods([ref])
+    assert enc.classes_stale
+    enc.projection_rewalk()
+    enc.intern_pods([a, b, ref])
+    # now `team` is referenced: the classes split — and the method path
+    # agrees with the re-walked inlined assignments
+    assert enc.pod_row(a)[2] != enc.pod_row(b)[2]
+    for p in (a, b, ref):
+        ns_id = enc.vocabs.namespaces.intern(p.namespace)
+        fp = enc.class_fingerprint(p, ns_id)
+        assert fp in enc._class_memo
+        assert enc._class_memo[fp] == enc.pod_row(p)[2]

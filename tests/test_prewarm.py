@@ -83,7 +83,7 @@ class TestTriggerPolicy:
         calls = []
         ev = threading.Event()
 
-        def fake_compile(d, engine, extras, gang, mesh=None):
+        def fake_compile(d, engine, extras, gang, mesh=None, rc=0):
             calls.append((d, engine, gang))
             ev.set()
         return calls, ev, fake_compile
@@ -181,7 +181,7 @@ class TestGrowthAcrossBucketBoundary:
         s = Scheduler(binder=binder, base_dims=Dims().grown_for(N=16, E=16))
         s.prewarmer = BucketPrewarmer(
             threshold=0.8, min_axis=8,
-            compile_fn=lambda d, e, x, g, m=None: calls.append(d))
+            compile_fn=lambda d, e, x, g, m=None, rc=0: calls.append(d))
 
         for i in range(8):
             s.on_node_add(mknode(i))
@@ -228,7 +228,7 @@ class TestMeshSignatureIsolation:
         calls = []
         pw = BucketPrewarmer(
             threshold=0.8, min_axis=8,
-            compile_fn=lambda d, e, x, g, m=None: calls.append((d, m)))
+            compile_fn=lambda d, e, x, g, m=None, rc=0: calls.append((d, m)))
         d = Dims().grown_for(N=16, E=16)
         pw.observe(d, n_nodes=14, n_existing=1)              # single-device
         pw.wait(5)
@@ -248,10 +248,15 @@ class TestMeshSignatureIsolation:
         from kubernetes_tpu.parallel.mesh import mesh_key
 
         base = replace(d, has_node_name=False)
-        pw.compiled[(base, "waves", (), False, mesh_key(mesh))] = "MESH-EXE"
-        pw.compiled[(base, "waves", (), False, None)] = "SINGLE-EXE"
+        pw.compiled[(base, "waves", (), False, 0, mesh_key(mesh))] = "MESH-EXE"
+        pw.compiled[(base, "waves", (), False, 0, None)] = "SINGLE-EXE"
         assert pw.lookup(d, "waves", (), False, mesh=mesh) == "MESH-EXE"
         assert pw.lookup(d, "waves", (), False, mesh=None) == "SINGLE-EXE"
+        # the run-collapsed engine's static run capacity is part of the key:
+        # a different run bucket is a different compiled program
+        pw.compiled[(base, "runs", (), False, 16, None)] = "RUNS-RC16"
+        assert pw.lookup(d, "runs", (), False, rc=16) == "RUNS-RC16"
+        assert pw.lookup(d, "runs", (), False, rc=32) is None
         # preempt programs carry the same isolation
         pw.compiled[pw._preempt_key(d, 8, mesh)] = "MESH-PREEMPT"
         assert pw.lookup_preempt(d, 8, mesh=None) is None
@@ -311,9 +316,11 @@ class TestMeshSignatureIsolation:
             lookups = []
             orig_lookup = s.prewarmer.lookup
 
-            def spy_lookup(d, engine, extras, gang, mesh=None):
+            def spy_lookup(d, engine, extras, gang, mesh=None,
+                           rc=0):
                 lookups.append(mesh_key(mesh))
-                return orig_lookup(d, engine, extras, gang, mesh=mesh)
+                return orig_lookup(d, engine, extras, gang, mesh=mesh,
+                                   rc=rc)
 
             s.prewarmer.lookup = spy_lookup
             for i in range(8):
