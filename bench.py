@@ -75,6 +75,11 @@ DEFAULT_STAGES = [
     (5000, 50000, "mesh"),   # LIVE scheduler on an 8-way virtual mesh:
                              # resident sharded state, donated patches,
                              # bit-equal placements vs single-device
+    (1000, 2000, "fleet"),   # ISSUE 6 smoke shape: 16 tenants × 1k nodes
+                             # × 2k pods stacked on the tenant-axis mesh —
+                             # one vmap'd dispatch per tick, DRF quotas,
+                             # zero cross-tenant placements (flagship
+                             # target: 100 × 5k, docs/FLEET.md)
     (5120, 50000, "multichip"),  # engine dryrun rungs → MULTICHIP_OUT
     (2000, 40000, "gang"),   # mid rung: a 5k gang timeout still leaves a number
     (5000, 100000, "gang"),
@@ -121,6 +126,10 @@ CYCLE_BUDGETS = {
     # is the dryrun's; this stage budgets the serving-path overheads)
     ("mesh", 5000): 60.0,
     ("multichip", 5120): 120.0,  # bench-rung sharded dispatch, warm
+    # worst steady fleet tick at the smoke shape (16 × 1k × 2k, 8-way
+    # virtual tenant mesh on CPU): the vmapped wave program over 16
+    # stacked tenants — the cold compile is excluded (first tick)
+    ("fleet", 1000): 300.0,
 }
 
 # Per-metric budgets beyond the cycle time (the host-pipeline-overlap PR's
@@ -168,6 +177,20 @@ METRIC_BUDGETS = {
                      "donation_failures": ("<=", 0),
                      "lost_pods": ("<=", 0)},
     ("multichip", 5120): {"rungs_bit_equal": (">=", 3)},
+    # ISSUE 6 acceptance: the whole fleet evaluates as ONE XLA dispatch
+    # per tick, DRF quotas are never violated, no placement ever lands
+    # outside its tenant's own cluster, and no tenant loses a pod (bound
+    # or still queued — a quota-clamped tenant's surplus stays queued)
+    ("fleet", 1000): {"fleet_dispatches_per_tick": ("<=", 1),
+                      "drf_violations": ("<=", 0),
+                      "cross_tenant_placements": ("<=", 0),
+                      "lost_pods": ("<=", 0),
+                      "double_bound": ("<=", 0),
+                      # the tight-quota tenant must actually hit the clamp:
+                      # a no-op clamp would pass every other budget at this
+                      # shape while the feature under test does nothing
+                      "drf_clamped": (">=", 1),
+                      "tenants_lossless": (">=", 1)},
 }
 
 
@@ -229,7 +252,7 @@ def _run_stage(n_nodes, n_pods, kind, env, timeout):
     # an ambient KTPU_MESH would silently mesh-back the single-device
     # baselines — including the mesh stage's own bit-equality reference
     env.pop("KTPU_MESH", None)
-    if kind in ("mesh", "multichip") \
+    if kind in ("mesh", "multichip", "fleet") \
             and os.environ.get("KTPU_MESH_STAGE_REAL") != "1":
         # the multichip stages run on an 8-way VIRTUAL CPU mesh (ISSUE 3:
         # --xla_force_host_platform_device_count=8) so the sharded serving
@@ -1127,6 +1150,127 @@ def _mesh_stage(n_nodes, n_pods):
     }))
 
 
+def _fleet_stage(n_nodes, n_pods):
+    """ISSUE 6 acceptance stage: K virtual tenant clusters (default 16,
+    KTPU_FLEET_TENANTS) of n_nodes × n_pods each, multiplexed through ONE
+    resident FleetServer on the 8-way virtual tenant-axis mesh. Every tick
+    is one vmap'd XLA dispatch with the DRF clamp in-graph; tenant 0 runs
+    under a tight quota so the clamp demonstrably fires (its surplus stays
+    QUEUED — per-tenant lost_pods stays 0). Emits per-tenant pods/s,
+    `drf_violations`, `cross_tenant_placements`, `fleet_dispatches_per_tick`
+    — METRIC_BUDGETS enforce 0/0/1 and losslessness."""
+    import jax
+
+    from kubernetes_tpu.api.types import Pod, Resources
+    from kubernetes_tpu.fleet import FleetServer
+    from kubernetes_tpu.models.workloads import make_nodes
+    from kubernetes_tpu.sched.scheduler import RecordingBinder
+    from kubernetes_tpu.state.dims import Dims, bucket
+
+    tenants = int(os.environ.get("KTPU_FLEET_TENANTS", "16"))
+    n_devices = len(jax.devices())
+    mesh = min(8, n_devices) if n_devices >= 2 else None
+    batch = min(4096, max(64, n_pods // 2))
+    base = Dims(N=bucket(n_nodes), P=bucket(batch), E=bucket(n_pods + 256))
+    clk = {"t": 0.0}
+    srv = FleetServer(batch_size=batch, base_dims=base, mesh=mesh,
+                      clock=lambda: clk["t"])
+    srv.prewarmer.enabled = False  # steady ticks, no concurrent compiles
+    nodes = make_nodes(n_nodes)
+    binders = {}
+    # tenant 0's quota funds only HALF its backlog: the clamp must fire
+    # (drf_clamped > 0) while still violating nothing. The per-pod
+    # dominant demand is the max over the encoded resource dims —
+    # including the implicit one-pod-slot demand (state/encode.py
+    # RES_PODS=1 per pod), which at this shape dominates 20m cpu:
+    # 1/(n_nodes*110 slots) vs 20/(n_nodes*32000 mcpu).
+    per_pod_dom = max(20.0 / (n_nodes * 32000.0),
+                      16.0 / (n_nodes * 128.0 * 1024.0),   # 16Mi of 128Gi
+                      1.0 / (n_nodes * 110.0))
+    tight_quota = max(n_pods * per_pod_dom / 2, 1e-5)
+    t0 = time.perf_counter()
+    for k in range(tenants):
+        name = f"t{k:02d}"
+        b = RecordingBinder()
+        binders[name] = b
+        t = srv.add_tenant(name, binder=b,
+                           quota=(tight_quota if k == 0 else 1.0))
+        for n in nodes:
+            t.on_node_add(n)
+        for i in range(n_pods):
+            t.on_pod_add(Pod(name=f"{name}-p{i}",
+                             requests=Resources.make(cpu="20m",
+                                                     memory="16Mi"),
+                             creation_index=i))
+    t_ingest = time.perf_counter() - t0
+
+    ticks = []
+    t0 = time.perf_counter()
+    max_ticks = int(os.environ.get("KTPU_FLEET_MAX_TICKS", "24"))
+    for _ in range(max_ticks):
+        c0 = time.perf_counter()
+        tk = srv.tick()
+        clk["t"] += 1.0
+        ticks.append((time.perf_counter() - c0, tk))
+        done = all(t.sched.queue.lengths()[0] == 0
+                   for t in srv.tenants.values())
+        if done or (tk.scheduled == 0 and len(ticks) > 2):
+            break
+    t_total = time.perf_counter() - t0
+
+    per_tenant_bound = {n: len(b.bound) for n, b in binders.items()}
+    scheduled = sum(per_tenant_bound.values())
+    # lost = created − bound − still queued (any lane) per tenant; a
+    # clamped tenant's surplus sits in its queue, which is NOT loss
+    lost_by_tenant = {}
+    double = 0
+    still_queued = 0
+    for name, b in binders.items():
+        keys = [k for k, _ in b.bound]
+        double += len(keys) - len(set(keys))
+        q = sum(srv.tenant(name).sched.queue.lengths())
+        still_queued += q
+        # dedupe before the loss math: a double-bound pod must not mask a
+        # lost one (len(keys) would count the duplicate as the missing pod)
+        lost_by_tenant[name] = n_pods - len(set(keys)) - q
+    lost = sum(lost_by_tenant.values())
+    steady = [w for w, _ in ticks[1:]] or [ticks[0][0]]
+    per_tenant_pps = {n: round(c / t_total, 1)
+                      for n, c in per_tenant_bound.items()}
+    print(json.dumps({
+        "nodes": n_nodes, "pods": n_pods, "kind": "fleet",
+        "tenants": tenants, "n_devices": n_devices,
+        "stack_k": srv.stack.K,
+        "scheduled": scheduled,
+        # clamped pods still sitting in their tenant's queue are DEFERRED,
+        # not failed — only pods neither bound nor queued count as failed
+        "failed": max(tenants * n_pods - scheduled - still_queued, 0),
+        "queued": still_queued,
+        "cycle_seconds": round(max(steady), 3),
+        "median_cycle_seconds": round(sorted(steady)[len(steady) // 2], 3),
+        "cold_tick_seconds": round(ticks[0][0], 3),
+        "ticks": len(ticks),
+        "ingest_seconds": round(t_ingest, 2),
+        "fleet_dispatches_per_tick": srv.max_dispatches_per_tick,
+        "drf_violations": srv.total_drf_violations,
+        "drf_clamped": srv.total_drf_clamped,
+        "cross_tenant_placements": srv.total_cross_tenant,
+        "full_restacks": srv.stack.full_restacks,
+        "donated_patches": srv.stack.donated_patches,
+        "donation_failures": srv.stack.donation_failures,
+        "lost_pods": lost,
+        "double_bound": double,
+        # 1 iff EVERY tenant individually lost nothing (the per-tenant
+        # budget, collapsed to one checkable metric)
+        "tenants_lossless": int(all(v == 0
+                                    for v in lost_by_tenant.values())),
+        "per_tenant_pods_per_sec_min": min(per_tenant_pps.values()),
+        "per_tenant_pods_per_sec": per_tenant_pps,
+        "pods_per_sec": round(scheduled / t_total, 1) if t_total else 0.0,
+        "backend": jax.default_backend(),
+    }))
+
+
 def _classes_stage(n_nodes, n_pods):
     """ISSUE 5 acceptance stage: equivalence-class collapsed admission on a
     deployment-style backlog (200 classes, replicas stamped in contiguous
@@ -1340,6 +1484,9 @@ def _stage_main(n_nodes, n_pods, kind):
     if kind == "mesh":
         _mesh_stage(n_nodes, n_pods)
         return
+    if kind == "fleet":
+        _fleet_stage(n_nodes, n_pods)
+        return
     if kind == "multichip":
         _multichip_stage(n_nodes, n_pods)
         return
@@ -1510,6 +1657,10 @@ def _compact_line(full, out_name, wrote):
             if r.get("kind") == "mesh":
                 e["bit_equal"] = r.get("bit_equal")
                 e["delta_up_s"] = r.get("delta_upload_seconds_mean")
+            if r.get("kind") == "fleet":
+                e["disp_per_tick"] = r.get("fleet_dispatches_per_tick")
+                e["drf_viol"] = r.get("drf_violations")
+                e["cross_tenant"] = r.get("cross_tenant_placements")
             if r.get("kind") == "multichip":
                 e["out"] = r.get("out")
             if r.get("within_budget") is False:

@@ -1,0 +1,15 @@
+"""Fleet serving: one resident scheduler, K virtual tenant clusters.
+
+The mesh-resident snapshot + bucket/prewarm machinery (sched/, state/,
+parallel/) serves ONE cluster. This package multiplexes K tenant clusters
+onto that machinery: per-tenant `ClusterTables` stack into a leading tenant
+axis (`tables.py`), one `vmap` of the existing cycle body evaluates every
+tenant in a single XLA dispatch per tick (`cycle.py`), dominant-resource-
+fairness quotas clamp admission as tensor ops over the stacked batch
+(`quota.py`), and `server.py` owns the per-tenant caches/queues/ledgers and
+the commit loop. See docs/FLEET.md.
+"""
+
+from .server import FleetServer, FleetTenant, FleetTickStats, tenant_ledger
+
+__all__ = ["FleetServer", "FleetTenant", "FleetTickStats", "tenant_ledger"]

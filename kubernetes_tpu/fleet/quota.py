@@ -1,0 +1,102 @@
+"""Dominant-resource-fairness quotas as tensor ops over the stacked batch.
+
+The fairness-as-policy framing (Gavel, PAPERS.md) re-expressed over the
+existing mask/score lattice: a tenant's quota is a fraction of its own
+cluster's capacity, its *dominant share* is the max over resource dims of
+used/capacity (the DRF dominant resource), and admission is clamped so one
+tick can never push a tenant past its quota — a tenant at quota contributes
+inert rows this tick, exactly as an invalid pod would.
+
+The clamp is a PURE PRE-MASK on `pending.valid`, computed inside the fleet
+dispatch (fleet/cycle.py) from the same stacked capacity/usage planes the
+engines read: downstream, the engines see a smaller valid set and nothing
+else, so per-tenant placements are bit-equal to running that tenant alone
+under the same clamp — the property tests/test_fleet.py enforces.
+
+The per-pod shape of the clamp is a prefix waterfill in queue order
+(priority desc, creation asc — ops/assign.py queue_order): pod i admits iff
+the tenant's pre-tick dominant share plus the cumulative dominant demand of
+pods 0..i stays ≤ quota. A tenant under quota admits exactly the prefix its
+headroom funds; a tenant at/over quota admits nothing with nonzero demand.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from ..ops.assign import queue_order
+from ..state.arrays import ClusterTables, PodArrays
+
+Array = jnp.ndarray
+
+# slack on the quota comparison: float32 shares accumulate over the prefix
+# cumsum, and a tenant sitting EXACTLY at quota must not flap on the last
+# ulp of a sum
+DRF_EPS = 1e-6
+
+
+def capacity_usage_planes(tables: ClusterTables) -> Tuple[Array, Array]:
+    """Per-resource totals over the tenant's LIVE nodes: ([R] capacity,
+    [R] used), float32 (KiB sums overflow int32 at ~60 nodes of 64Gi; the
+    shares these feed are ratios, where float32 is plenty)."""
+    nodes = tables.nodes
+    live = nodes.valid[:, None]
+    cap = jnp.where(live, nodes.alloc, 0).astype(jnp.float32).sum(axis=0)
+    used = jnp.where(live, nodes.used, 0).astype(jnp.float32).sum(axis=0)
+    return cap, used
+
+
+def dominant_share(tables: ClusterTables) -> Array:
+    """The DRF dominant share: max over resource dims of used/capacity,
+    0 where the tenant has no capacity at all (an empty/pad tenant)."""
+    cap, used = capacity_usage_planes(tables)
+    safe = jnp.maximum(cap, 1.0)
+    return jnp.max(jnp.where(cap > 0, used / safe, 0.0))
+
+
+def drf_admission_row(tables: ClusterTables, pending: PodArrays,
+                      quota: Array) -> Tuple[Array, Array, Array]:
+    """One tenant's DRF clamp: (admission mask [P], pre-tick dominant
+    share [], per-pod dominant demand [P]). vmapped over the tenant axis
+    by fleet/cycle.py; callable standalone (K-free) for goldens and for
+    the single-tenant reference run the bit-equality suite compares
+    against."""
+    cap, used = capacity_usage_planes(tables)
+    safe = jnp.maximum(cap, 1.0)
+    live = cap > 0
+    # XLA CSEs the repeated capacity reduction inside the fleet program,
+    # so sharing the helper costs nothing
+    share = dominant_share(tables)
+
+    rid = jnp.maximum(tables.classes.rid[jnp.maximum(pending.cls, 0)], 0)
+    req = tables.reqs.vec[rid].astype(jnp.float32)          # [P, R]
+    dom = jnp.max(jnp.where(live[None, :], req / safe[None, :], 0.0),
+                  axis=1)                                    # [P]
+
+    # prefix waterfill in queue order: the clamp admits exactly the pods
+    # the wave would pop first — so clamping commutes with the engines'
+    # own ordering and the tick stays bit-equal to a solo run
+    order = queue_order(pending)
+    dom_sorted = jnp.where(pending.valid[order], dom[order], 0.0)
+    cum = jnp.cumsum(dom_sorted)
+    ok_sorted = share + cum <= quota + DRF_EPS
+    ok = jnp.zeros_like(pending.valid).at[order].set(ok_sorted)
+    return pending.valid & ok, share, dom
+
+
+def violation_headroom(share: Array, dom: Array, admitted: Array,
+                       quota: Array, xp=jnp) -> Array:
+    """Per-tenant DRF invariant check, computed from the dispatch's own
+    outputs: the admitted prefix's total dominant demand must fit the
+    tenant's remaining headroom. True = violated (the budget the fleet
+    bench enforces to zero). Shapes: share/quota [K], dom/admitted [K, P].
+
+    `xp` picks the array module: the fleet commit loop passes numpy so the
+    check runs as pure host arithmetic on already-fetched outputs — a jnp
+    call there would dispatch on the DEFAULT backend, which mid-degraded-
+    tick may be the dead one (the hazard sched/cycle.py documents)."""
+    demand = xp.where(admitted, dom, 0.0).sum(axis=-1)
+    headroom = xp.maximum(quota - share, 0.0)
+    return demand > headroom + DRF_EPS

@@ -39,6 +39,13 @@ from ..state.arrays import ClusterTables, NodeArrays
 
 NODE_AXIS = "nodes"
 
+# the FLEET axis (fleet/ subsystem): K virtual tenant clusters stacked on a
+# leading axis and split across chips — each chip owns K/n_devices whole
+# tenants, so the vmap'd fleet cycle needs NO cross-chip collectives at all
+# (tenants are independent by construction; contrast the node-axis split,
+# whose per-step argmax/psum spans every chip)
+TENANT_AXIS = "tenants"
+
 XLA_MESH_HINT = (
     "set XLA_FLAGS=--xla_force_host_platform_device_count=<n> and "
     "JAX_PLATFORMS=cpu for a virtual mesh"
@@ -187,6 +194,48 @@ def replicate(tree, mesh: Mesh):
     return jax.tree.map(
         lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree
     )
+
+
+# ---------------------------------------------------------------------- #
+# fleet (tenant-axis) sharding — fleet/tables.py stacks K tenant clusters
+# on a leading axis; these helpers split that axis across the mesh
+# ---------------------------------------------------------------------- #
+
+
+def make_fleet_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D mesh over the TENANT axis. Same device discipline as
+    `make_mesh` (raises with the virtual-mesh hint when short), different
+    axis name so a fleet program and a node-sharded program can never
+    accidentally share sharding annotations."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if len(devs) < n:
+        err = RuntimeError(
+            f"make_fleet_mesh({n}): only {len(devs)} devices visible")
+        err.__notes__ = [XLA_MESH_HINT]
+        raise err
+    return Mesh(np.array(devs[:n]), (TENANT_AXIS,))
+
+
+def padded_tenant_count(k: int, n_devices: int) -> int:
+    """Smallest multiple of n_devices ≥ k — inert (empty-cluster) tenant
+    slots pad the difference, exactly the `pad_node_tables` inert-row
+    contract lifted one axis up."""
+    return padded_node_count(k, n_devices)
+
+
+def fleet_sharding(mesh: Mesh) -> NamedSharding:
+    """The one NamedSharding of the fleet layout: every stacked leaf splits
+    its leading (tenant) axis; later axes stay unsharded."""
+    return NamedSharding(mesh, P(TENANT_AXIS))
+
+
+def shard_fleet(tree, mesh: Mesh):
+    """Place a stacked fleet pytree (every leaf [K, …]) on the mesh, tenant
+    axis split. K must already be a multiple of the mesh size — the fleet
+    stack pads with inert tenants first (fleet/tables.py)."""
+    sh = fleet_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
 
 
 class MeshState:

@@ -50,6 +50,36 @@ RECOVERED_INTENTS = REG.counter(
     "scheduler_recovered_bind_intents_total",
     "Unretired bind intents replayed at startup/takeover",
     labels=("outcome",))
+# fleet serving (fleet/server.py): per-TENANT per-tick counters, so the
+# chaos suite and the fleet bench stage prove tenant isolation from
+# metrics (one tenant's storm degrades only its own series)
+TENANT_ADMITTED = REG.counter(
+    "scheduler_fleet_tenant_admitted_total",
+    "Pods admitted (bound) per tenant per fleet tick", labels=("tenant",))
+TENANT_REQUEUED = REG.counter(
+    "scheduler_fleet_tenant_requeued_total",
+    "Pods requeued without a failure verdict (quota clamp, storm, abort) "
+    "per tenant", labels=("tenant",))
+TENANT_DEGRADED = REG.counter(
+    "scheduler_fleet_tenant_degraded_ticks_total",
+    "Fleet ticks in which the tenant was storm-degraded",
+    labels=("tenant",))
+DRF_CLAMPED = REG.counter(
+    "scheduler_fleet_drf_clamped_total",
+    "Pending pods clamped inert by the DRF quota pre-mask",
+    labels=("tenant",))
+
+
+def observe_fleet_tick(per_tenant) -> None:
+    """Record one fleet tick's per-tenant outcomes (fleet/server.py calls
+    this with {tenant name → CycleStats})."""
+    for name, st in per_tenant.items():
+        if st.scheduled:
+            TENANT_ADMITTED.inc(st.scheduled, tenant=name)
+        if st.requeued:
+            TENANT_REQUEUED.inc(st.requeued, tenant=name)
+        if st.degraded:
+            TENANT_DEGRADED.inc(st.degraded, tenant=name)
 
 
 def observe_wave(stats, queue_lengths, cache_counts) -> None:

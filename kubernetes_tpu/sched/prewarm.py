@@ -189,9 +189,12 @@ class BucketPrewarmer:
         self._inflight_preempt: Optional[threading.Thread] = None
         self._compile_fn = compile_fn or self._compile
         self.warm_log: list = []   # (dims, engine) actually compiled — tests
-        # (dims, engine, extras, gang, rc, mesh sig) → jax Compiled for the
-        # cycle program (rc = the run-collapsed engine's static run
-        # capacity, 0 for the other engines);
+        # (dims, engine, extras, gang, rc, fleet, mesh sig) → jax Compiled
+        # for the cycle program (rc = the run-collapsed engine's static run
+        # capacity, 0 for the other engines; fleet = the tenant-stack count
+        # K of a fleet/cycle.py program, None for single-cluster — the slot
+        # that makes it impossible for a K-tenant Compiled to be handed a
+        # single cluster's arrays or vice versa);
         # ("preempt", dims, burst) → Compiled for the preemption burst
         self.compiled: dict = {}
         # bumped by invalidate(): a background compile that STARTED before a
@@ -212,7 +215,8 @@ class BucketPrewarmer:
 
     def observe(self, d: Dims, n_nodes: int, n_existing: int,
                 engine: str = "waves", extras: tuple = (),
-                gang: bool = False, mesh=None, rc: int = 0) -> None:
+                gang: bool = False, mesh=None, rc: int = 0,
+                fleet=None) -> None:
         """Call once per cycle with live occupancy (and whether batches are
         gang-bearing — gangs trace a different program; and which mesh the
         cycle dispatches on — a sharded program is a different executable).
@@ -237,7 +241,7 @@ class BucketPrewarmer:
             if target == d:
                 continue
             key = (replace(target, has_node_name=False), engine, extras,
-                   gang, rc, msig)
+                   gang, rc, fleet, msig)
             with self._mu:
                 if key in self._warmed:
                     continue
@@ -246,7 +250,7 @@ class BucketPrewarmer:
                 self._warmed.add(key)
                 t = threading.Thread(
                     target=self._compile_fn,
-                    args=(target, engine, extras, gang, mesh, rc),
+                    args=(target, engine, extras, gang, mesh, rc, fleet),
                     name=f"ktpu-prewarm-{target.N}x{target.E}", daemon=True)
                 # start BEFORE publishing: wait() joins _inflight without
                 # the lock, and joining a not-yet-started thread raises
@@ -255,9 +259,9 @@ class BucketPrewarmer:
             return
 
     def _compile(self, d: Dims, engine: str, extras: tuple,
-                 gang: bool, mesh=None, rc: int = 0) -> None:
+                 gang: bool, mesh=None, rc: int = 0, fleet=None) -> None:
         key = (replace(d, has_node_name=False), engine, extras, gang,
-               rc, self._mesh_sig(mesh))
+               rc, fleet, self._mesh_sig(mesh))
         epoch = self._epoch
         try:
             from ..utils import faultline
@@ -267,13 +271,27 @@ class BucketPrewarmer:
             if faultline.should("device.error", "prewarm"):
                 raise InjectedDeviceError(
                     "injected XlaRuntimeError at prewarm")
-            (tables, pending, keys, existing, hw, ecfg,
-             gang_args) = abstract_cycle_args(d, gang=gang, mesh=mesh)
-            compiled = _schedule_batch_impl.lower(
-                tables, pending, keys, d.D, existing, engine, hw, ecfg,
-                extras, tuple(1.0 for _ in extras), gang_args,
-                False, rc,
-            ).compile()
+            if fleet is not None:
+                # a tenant-stack program (fleet/cycle.py): K virtual
+                # clusters per dispatch — a structurally different
+                # executable from the single-cluster one at the same dims
+                from ..fleet.cycle import _fleet_cycle_impl
+                from ..fleet.tables import abstract_fleet_args
+
+                (tables, pending, keys, existing, quota,
+                 hw, ecfg) = abstract_fleet_args(d, int(fleet), mesh=mesh)
+                compiled = _fleet_cycle_impl.lower(
+                    tables, pending, keys, d.D, existing, engine, quota,
+                    hw, ecfg, rc,
+                ).compile()
+            else:
+                (tables, pending, keys, existing, hw, ecfg,
+                 gang_args) = abstract_cycle_args(d, gang=gang, mesh=mesh)
+                compiled = _schedule_batch_impl.lower(
+                    tables, pending, keys, d.D, existing, engine, hw, ecfg,
+                    extras, tuple(1.0 for _ in extras), gang_args,
+                    False, rc,
+                ).compile()
             with self._mu:
                 if epoch != self._epoch:
                     # invalidate() ran mid-compile (backend loss): this
@@ -294,15 +312,18 @@ class BucketPrewarmer:
                 self.supervisor.note_compile_failure(e)
 
     def lookup(self, d: Dims, engine: str, extras: tuple, gang: bool,
-               mesh=None, rc: int = 0):
+               mesh=None, rc: int = 0, fleet=None):
         """The stored Compiled for this cycle signature, or None. Called on
         the dispatch hot path — one dict probe. The mesh signature is part
         of the key, so a single-device caller can NEVER receive a
         mesh-sharded executable (or vice versa) — the isolation that keeps
-        a degraded wave from resharding its arrays onto lost devices."""
+        a degraded wave from resharding its arrays onto lost devices. The
+        fleet slot isolates the same way one layer up: a K-tenant stacked
+        program and a single-cluster program at identical dims are
+        different executables (fleet/cycle.py)."""
         return self.compiled.get(
             (replace(d, has_node_name=False), engine, extras, gang,
-             rc, self._mesh_sig(mesh)))
+             rc, fleet, self._mesh_sig(mesh)))
 
     def invalidate(self) -> None:
         """Drop every stored executable and warm record, and fence out
@@ -316,7 +337,8 @@ class BucketPrewarmer:
             self._warmed.clear()
 
     def rewarm(self, d: Dims, engine: str = "waves", extras: tuple = (),
-               gang: bool = False, mesh=None, rc: int = 0) -> bool:
+               gang: bool = False, mesh=None, rc: int = 0,
+               fleet=None) -> bool:
         """Force a background compile of the CURRENT dims regardless of
         occupancy thresholds — the backend re-admission path: the recovered
         device's first wave should deserialize a warm executable, not pay a
@@ -331,14 +353,15 @@ class BucketPrewarmer:
         if max(d.N, d.E) < self.min_axis:
             return False  # small shapes recompile in seconds on demand
         key = (replace(d, has_node_name=False), engine, extras, gang,
-               rc, self._mesh_sig(mesh))
+               rc, fleet, self._mesh_sig(mesh))
         with self._mu:
             self._warmed.add(key)
             prev = self._inflight
             if prev is not None and prev.is_alive():
                 def chained():
                     prev.join()
-                    self._compile_fn(d, engine, extras, gang, mesh, rc)
+                    self._compile_fn(d, engine, extras, gang, mesh, rc,
+                                     fleet)
 
                 t = threading.Thread(
                     target=chained,
@@ -346,7 +369,7 @@ class BucketPrewarmer:
             else:
                 t = threading.Thread(
                     target=self._compile_fn,
-                    args=(d, engine, extras, gang, mesh, rc),
+                    args=(d, engine, extras, gang, mesh, rc, fleet),
                     name=f"ktpu-rewarm-{d.N}x{d.E}", daemon=True)
             # start BEFORE publishing (wait() joins without the lock; a
             # not-yet-started thread would raise there). rewarm runs on the
@@ -356,7 +379,8 @@ class BucketPrewarmer:
         return True
 
     def ensure_warm(self, d: Dims, engine: str = "waves", extras: tuple = (),
-                    gang: bool = False, mesh=None, rc: int = 0) -> bool:
+                    gang: bool = False, mesh=None, rc: int = 0,
+                    fleet=None) -> bool:
         """The warm-standby beat (Scheduler.warm_standby): compile this
         exact signature in the background IF it is neither compiled nor
         already compiling — idempotent, unlike rewarm (which always
@@ -365,13 +389,13 @@ class BucketPrewarmer:
         if not self.enabled or max(d.N, d.E) < self.min_axis:
             return False
         key = (replace(d, has_node_name=False), engine, extras, gang,
-               rc, self._mesh_sig(mesh))
+               rc, fleet, self._mesh_sig(mesh))
         with self._mu:
             # _warmed covers both finished compiles (the key stays) and
             # in-flight ones (added before the thread starts)
             if key in self._warmed:
                 return False
-        return self.rewarm(d, engine, extras, gang, mesh, rc)
+        return self.rewarm(d, engine, extras, gang, mesh, rc, fleet)
 
     # ---- preemption-burst program (sched/preemption.py _preempt) ---- #
 

@@ -73,6 +73,15 @@ class CycleStats:
     # scan-step reduction P_valid/runs the collapse bought this wave
     class_runs: int = 0
     collapse_ratio: float = 0.0
+    # fleet-tick telemetry (fleet/server.py, per TENANT per tick): pods
+    # sent back to the queue without a failure verdict this tick (DRF
+    # quota clamp, storm requeue, abort — they retry promptly, unlike
+    # `unschedulable`), and whether this tenant's tick was degraded (its
+    # injected watch storm forced a full re-encode + requeue). The chaos
+    # suite and the fleet bench stage assert tenant ISOLATION from these
+    # counters instead of scraping logs.
+    requeued: int = 0
+    degraded: int = 0
     cycle_seconds: float = 0.0
     assignments: Dict[str, str] = field(default_factory=dict)
     # pod keys that failed this wave (feeds FailedScheduling events)

@@ -157,6 +157,11 @@ class DispatchSupervisor:
         # re-admission reforms the mesh — narrower unless a full-width
         # probe passes — and the next snapshot re-shards from host staging
         self.mesh_state = mesh_state
+        # alternative mesh source for re-admission rewarm when there is no
+        # node-axis mesh_state — the fleet server sets this to its
+        # tenant-axis mesh so the rewarmed executable lands under the SAME
+        # key the live fleet dispatch looks up (fleet/cycle.py)
+        self.mesh_provider: Optional[Callable[[], Any]] = None
         self.stats = SupervisorStats()
         self._mu = threading.Lock()
         self._healthy = True
@@ -227,12 +232,14 @@ class DispatchSupervisor:
         return self.mesh_state.mesh
 
     def note_cycle_signature(self, dims, engine: str, extras: tuple,
-                             gang: bool, rc: int = 0) -> None:
+                             gang: bool, rc: int = 0, fleet=None) -> None:
         """Remember what the live cycle program looks like so re-admission
         can warm exactly it (the mesh itself is NOT part of the note: the
         rewarm targets whatever mesh exists post-reform, never the dead
-        one's signature)."""
-        self._cycle_sig = (dims, engine, extras, gang, rc)
+        one's signature). `fleet` is the tenant-stack count when the live
+        program is a fleet cycle (fleet/cycle.py) — the rewarm must target
+        the stacked executable, not the single-cluster one."""
+        self._cycle_sig = (dims, engine, extras, gang, rc, fleet)
 
     def _mark_unhealthy(self, reason: str) -> None:
         with self._mu:
@@ -372,11 +379,17 @@ class DispatchSupervisor:
                 mesh = self.mesh_state.reform(full=self._probe_mesh_full())
             except Exception:  # noqa: BLE001 - single-device serving is
                 mesh = None    # always a legal landing spot
+        elif self.mesh_provider is not None:
+            try:
+                mesh = self.mesh_provider()
+            except Exception:  # noqa: BLE001 - rewarm is an optimization
+                mesh = None
         if self.prewarmer is not None and sig is not None:
-            dims, engine, extras, gang, rc = sig
+            dims, engine, extras, gang, rc, fleet = sig
             try:
                 if self.prewarmer.rewarm(dims, engine=engine, extras=extras,
-                                         gang=gang, mesh=mesh, rc=rc):
+                                         gang=gang, mesh=mesh, rc=rc,
+                                         fleet=fleet):
                     self.stats.rewarms += 1
             except Exception:  # noqa: BLE001 - rewarm is an optimization
                 pass

@@ -500,7 +500,13 @@ class SchedulerCache:
             snap = self._snapshot
             if snap is not None and snap.generation == gen \
                     and snap.pending_keys == pending_keys \
-                    and snap.device == device and snap.mesh is mesh:
+                    and snap.device == device and snap.mesh is mesh \
+                    and (base_dims is None
+                         or snap.dims == snap.dims.union(base_dims)):
+                # the base_dims guard: a caller may GROW the floor between
+                # calls (the fleet bucket following another tenant's
+                # growth) — a cached snapshot at the old capacities must
+                # not short-circuit the re-encode that pads this tenant up
                 self.last_snapshot_mode = "cached"
                 return snap
 
@@ -599,8 +605,14 @@ class SchedulerCache:
                 list(self._nodes.values()),
                 # capacities are monotonic ACROSS cycles: seed from the live
                 # snapshot so a smaller pending batch doesn't shrink P and
-                # masquerade as a capacity change
-                snap.dims if snap is not None else base_dims,
+                # masquerade as a capacity change. The seed is the UNION of
+                # the live snapshot's dims and the caller's base_dims — the
+                # fleet layer (fleet/tables.py) grows the shared tenant
+                # bucket when ANY tenant grows, and every other tenant's
+                # snapshot must follow it up (stacked emission: one vmap'd
+                # program serves all tenants, so their shapes must agree)
+                snap.dims.union(base_dims) if snap is not None
+                else base_dims,
             )
             # the engine-routing flag is per-batch, not a capacity: it must
             # not force a full re-encode when it flips

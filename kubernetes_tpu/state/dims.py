@@ -9,7 +9,8 @@ and slices everywhere); this module is where its ragged world becomes rectangula
 
 from __future__ import annotations
 
-from dataclasses import dataclass, replace
+from dataclasses import dataclass, fields, replace
+from typing import Optional
 
 
 def bucket(n: int, minimum: int = 1, align: int = 1) -> int:
@@ -85,6 +86,25 @@ class Dims:
     # host-side facts about the encoded batch (not capacities): lets the
     # dispatch layer pick an engine without a device round-trip
     has_node_name: bool = False  # any pending pod sets spec.nodeName
+
+    def union(self, other: Optional["Dims"]) -> "Dims":
+        """Field-wise max of two capacity sets — the shared FLEET bucket K
+        stacked tenant clusters must agree on (fleet/tables.py): every
+        tenant's tables pad up to the union so one vmap'd program serves
+        them all. `has_node_name` ORs (it is a per-batch routing fact, not
+        a capacity). Never shrinks either operand."""
+        if other is None or other == self:
+            return self
+        updates = {}
+        for f in fields(self):
+            a, b = getattr(self, f.name), getattr(other, f.name)
+            if f.name == "has_node_name":
+                v = bool(a or b)
+            else:
+                v = max(a, b)
+            if v != a:
+                updates[f.name] = v
+        return replace(self, **updates) if updates else self
 
     def grown_for(self, **mins: int) -> "Dims":
         """Return dims with each named capacity bucketed up to at least the

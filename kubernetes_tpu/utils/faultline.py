@@ -47,6 +47,17 @@ Seams wired in this repo (fault name → injection point):
                                               `except Exception` guard the
                                               way SIGKILL punches through a
                                               process (restart drills)
+    tenant.storm                              fleet/server.py per-tenant
+                                              tick (site = tenant name,
+                                              e.g. "tenant.storm@t02:1+"):
+                                              an injected watch storm for
+                                              ONE tenant — its snapshot is
+                                              invalidated (full re-encode)
+                                              and its popped batch requeues
+                                              promptly, degrading only that
+                                              tenant's cycle stats; the
+                                              chaos suite proves the other
+                                              tenants' ticks are untouched
 
 The hot-path contract: when no spec is installed, ``should()`` is one global
 read and a ``None`` check — safe to call per storage CAS or per watch event.

@@ -83,7 +83,8 @@ class TestTriggerPolicy:
         calls = []
         ev = threading.Event()
 
-        def fake_compile(d, engine, extras, gang, mesh=None, rc=0):
+        def fake_compile(d, engine, extras, gang, mesh=None, rc=0,
+                         fleet=None):
             calls.append((d, engine, gang))
             ev.set()
         return calls, ev, fake_compile
@@ -181,7 +182,8 @@ class TestGrowthAcrossBucketBoundary:
         s = Scheduler(binder=binder, base_dims=Dims().grown_for(N=16, E=16))
         s.prewarmer = BucketPrewarmer(
             threshold=0.8, min_axis=8,
-            compile_fn=lambda d, e, x, g, m=None, rc=0: calls.append(d))
+            compile_fn=lambda d, e, x, g, m=None, rc=0, fleet=None:
+            calls.append(d))
 
         for i in range(8):
             s.on_node_add(mknode(i))
@@ -228,7 +230,8 @@ class TestMeshSignatureIsolation:
         calls = []
         pw = BucketPrewarmer(
             threshold=0.8, min_axis=8,
-            compile_fn=lambda d, e, x, g, m=None, rc=0: calls.append((d, m)))
+            compile_fn=lambda d, e, x, g, m=None, rc=0, fleet=None:
+            calls.append((d, m)))
         d = Dims().grown_for(N=16, E=16)
         pw.observe(d, n_nodes=14, n_existing=1)              # single-device
         pw.wait(5)
@@ -248,13 +251,14 @@ class TestMeshSignatureIsolation:
         from kubernetes_tpu.parallel.mesh import mesh_key
 
         base = replace(d, has_node_name=False)
-        pw.compiled[(base, "waves", (), False, 0, mesh_key(mesh))] = "MESH-EXE"
-        pw.compiled[(base, "waves", (), False, 0, None)] = "SINGLE-EXE"
+        pw.compiled[(base, "waves", (), False, 0, None,
+                     mesh_key(mesh))] = "MESH-EXE"
+        pw.compiled[(base, "waves", (), False, 0, None, None)] = "SINGLE-EXE"
         assert pw.lookup(d, "waves", (), False, mesh=mesh) == "MESH-EXE"
         assert pw.lookup(d, "waves", (), False, mesh=None) == "SINGLE-EXE"
         # the run-collapsed engine's static run capacity is part of the key:
         # a different run bucket is a different compiled program
-        pw.compiled[(base, "runs", (), False, 16, None)] = "RUNS-RC16"
+        pw.compiled[(base, "runs", (), False, 16, None, None)] = "RUNS-RC16"
         assert pw.lookup(d, "runs", (), False, rc=16) == "RUNS-RC16"
         assert pw.lookup(d, "runs", (), False, rc=32) is None
         # preempt programs carry the same isolation
@@ -288,6 +292,46 @@ class TestMeshSignatureIsolation:
             tables, pending, keys, d.D, existing, "waves", hw, ecfg,
             (), (), gang).compile()
         assert compiled is not None
+
+    def test_fleet_and_single_cluster_never_cross(self):
+        """ISSUE 6: the tenant-stack signature is a key slot of its own — a
+        K-tenant fleet Compiled is invisible to a single-cluster lookup at
+        identical dims (and vice versa), across every K."""
+        from dataclasses import replace
+
+        pw = BucketPrewarmer(threshold=0.8, min_axis=8)
+        d = Dims().grown_for(N=16, E=16)
+        base = replace(d, has_node_name=False)
+        pw.compiled[(base, "waves", (), False, 0, 8, None)] = "FLEET-K8"
+        pw.compiled[(base, "waves", (), False, 0, None, None)] = "SINGLE"
+        assert pw.lookup(d, "waves", (), False, fleet=8) == "FLEET-K8"
+        assert pw.lookup(d, "waves", (), False) == "SINGLE"
+        assert pw.lookup(d, "waves", (), False, fleet=16) is None
+        # fleet × mesh compose: a tenant-axis-sharded fleet executable is
+        # yet another key, invisible to both of the above
+        mesh = self._mesh()
+        from kubernetes_tpu.parallel.mesh import mesh_key
+
+        pw.compiled[(base, "waves", (), False, 0, 8,
+                     mesh_key(mesh))] = "FLEET-K8-MESH"
+        assert pw.lookup(d, "waves", (), False, fleet=8,
+                         mesh=mesh) == "FLEET-K8-MESH"
+        assert pw.lookup(d, "waves", (), False, fleet=8) == "FLEET-K8"
+
+    def test_fleet_warm_compiles_the_stacked_program(self):
+        """ensure_warm(fleet=K) must AOT-compile fleet/cycle.py's vmapped
+        program from abstract shapes and store it under the fleet key —
+        the executable the live fleet tick then calls directly."""
+        d = Dims().grown_for(N=16, P=16, E=16)
+        pw = BucketPrewarmer(threshold=0.8, min_axis=8)
+        assert pw.ensure_warm(d, "waves", fleet=4)
+        pw.wait(120)
+        compiled = pw.lookup(d, "waves", (), False, fleet=4)
+        assert compiled is not None
+        # the single-cluster slot stays empty: nothing leaked across
+        assert pw.lookup(d, "waves", (), False) is None
+        # and the warm is idempotent per signature
+        assert not pw.ensure_warm(d, "waves", fleet=4)
 
     @pytest.mark.chaos
     def test_loss_fallback_readmission_never_crosses_signatures(self):
