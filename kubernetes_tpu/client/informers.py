@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from kubernetes_tpu.component.metrics import DEFAULT_REGISTRY as _REG
 from kubernetes_tpu.machinery import errors, meta
 from kubernetes_tpu.machinery import watch as mwatch
 from kubernetes_tpu.machinery.wait import Backoff
@@ -22,6 +23,20 @@ from kubernetes_tpu.utils import faultline
 
 Obj = Dict[str, Any]
 IndexFn = Callable[[Obj], List[str]]
+
+# ingest telemetry (ISSUE 7): watch-event volume per resource/type and the
+# relist cadence — the denominators the watch→bind e2e latency histogram
+# (sched/metrics.py POD_E2E_LATENCY) is read against. The scheduler's pod
+# stamp itself happens at handler time (the queue-add inside the dispatch
+# below), so these series bound how much ingest the stamps cover.
+INFORMER_EVENTS = _REG.counter(
+    "informer_watch_events_total",
+    "Watch events dispatched to informer handlers",
+    labels=("resource", "type"))
+INFORMER_RELISTS = _REG.counter(
+    "informer_relists_total",
+    "Full list+replace rounds (initial sync, 410 Gone, deaf watch)",
+    labels=("resource",))
 
 
 class RelistBackoff:
@@ -230,6 +245,7 @@ class SharedInformer:
                 return
 
     def _list_and_watch(self) -> None:
+        INFORMER_RELISTS.inc(resource=self.rc.resource)
         lst = self.rc.list(self.namespace, self.label_selector,
                            self.field_selector)
         items = lst.get("items", [])
@@ -317,6 +333,7 @@ class SharedInformer:
                 # must not spin the resume loop hot
 
     def _dispatch(self, ev: mwatch.Event) -> None:
+        INFORMER_EVENTS.inc(resource=self.rc.resource, type=str(ev.type))
         with self._handler_mu:
             handlers = list(self._handlers)
         if ev.type == mwatch.ADDED:

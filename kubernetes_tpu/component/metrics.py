@@ -4,10 +4,20 @@ Analog of `staging/src/k8s.io/component-base/metrics` (the Prometheus
 client wrapper every binary shares): Counter/Gauge/Histogram vectors with
 label sets, a process-wide default registry, and the text format served at
 /metrics (`pkg/scheduler/metrics/metrics.go` registers into exactly this).
+
+Concurrency contract (audited for ISSUE 7 — the serving loop, the
+supervisor's watchdog worker, the background prober, the prewarmer's
+compile thread and the consistency sweeper all touch these concurrently):
+every read AND write of a metric's state happens under that metric's own
+`_mu`, so increments are never lost (tests/test_telemetry.py hammers this).
+Lock ordering is registry → metric only (`expose_text` holds the registry
+lock while each metric exposes under its own); metric methods never take
+the registry lock, so the ordering cannot invert.
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -50,6 +60,13 @@ class Counter(_Metric):
         with self._mu:
             return self._values.get(self._key(labels), 0.0)
 
+    def total(self) -> float:
+        """Sum over every label combination (tests/bench assert aggregate
+        outcomes — e.g. `drf_clamped >= 1` across all tenants — without
+        enumerating the label space)."""
+        with self._mu:
+            return sum(self._values.values())
+
     def expose(self) -> List[str]:
         with self._mu:
             out = [f"# HELP {self.name} {self.help}",
@@ -87,12 +104,17 @@ class Histogram(_Metric):
         self._totals: Dict[Tuple[str, ...], int] = {}
 
     def observe(self, value: float, **labels) -> None:
+        # counts are stored PER BUCKET (non-cumulative) and accumulated at
+        # expose/quantile time: observe is on the per-pod hot path (the
+        # e2e latency histogram fires once per Binding), and a Python loop
+        # over every bucket per observation was a measurable slice of the
+        # telemetry overhead budget — one bisect is not
         with self._mu:
             k = self._key(labels)
             counts = self._counts.setdefault(k, [0] * len(self.buckets))
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    counts[i] += 1
+            i = bisect.bisect_left(self.buckets, value)
+            if i < len(counts):
+                counts[i] += 1
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._totals[k] = self._totals.get(k, 0) + 1
 
@@ -115,7 +137,7 @@ class Histogram(_Metric):
             target = q * total
             acc = 0
             for i, b in enumerate(self.buckets):
-                acc = self._counts[k][i]
+                acc += self._counts[k][i]
                 if acc >= target:
                     return b
             return float("inf")
@@ -125,14 +147,16 @@ class Histogram(_Metric):
             out = [f"# HELP {self.name} {self.help}",
                    f"# TYPE {self.name} {self.TYPE}"]
             for k in sorted(self._totals):
+                acc = 0
                 for i, b in enumerate(self.buckets):
                     # no backslashes inside f-string expressions: that is a
                     # Python ≥3.12 feature and this tree must import on 3.10
                     le = 'le="%s"' % b
+                    acc += self._counts[k][i]  # cumulative le semantics
                     out.append(
                         f"{self.name}_bucket"
                         f"{self._fmt_labels(self.label_names, k, le)}"
-                        f" {self._counts[k][i]}")
+                        f" {acc}")
                 le_inf = 'le="+Inf"'
                 out.append(f"{self.name}_bucket"
                            f"{self._fmt_labels(self.label_names, k, le_inf)}"

@@ -16,12 +16,17 @@ from typing import Callable, List, Optional, Tuple
 logger = logging.getLogger("kubernetes_tpu.trace")
 
 
+#: default LogIfLong threshold (the reference's 100ms scheduler trace bound)
+DEFAULT_THRESHOLD = 0.1
+
+
 class Trace:
     def __init__(self, name: str, clock: Callable[[], float] = time.monotonic,
-                 **fields):
+                 threshold: float = DEFAULT_THRESHOLD, **fields):
         self.name = name
         self.fields = fields
         self.clock = clock
+        self.threshold = threshold
         self.start = clock()
         self.steps: List[Tuple[float, str]] = []
         self._ended: Optional[float] = None
@@ -54,8 +59,12 @@ class Trace:
     def __enter__(self) -> "Trace":
         return self
 
-    def __exit__(self, *exc) -> None:
-        self.log_if_long(0.1)
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # exiting on an exception: the operation's failure path already
+        # reports (and the timeline would blame the step that happened to
+        # be open when the raise unwound) — only log clean slow exits
+        if exc_type is None:
+            self.log_if_long(self.threshold)
 
 
 def device_step_marker(name: str):

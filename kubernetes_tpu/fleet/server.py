@@ -115,7 +115,9 @@ class FleetTickStats:
     drf_violations: int = 0           # tenants whose admitted demand broke
                                       # their headroom (budget: 0)
     drf_clamped: int = 0              # pods deferred by the quota pre-mask
-    drf_clamped_by_tenant: Dict[str, int] = field(default_factory=dict)
+                                      # (per-tenant attribution lives on
+                                      # CycleStats.drf_clamped → the
+                                      # tenant-labelled DRF_CLAMPED metric)
     cross_tenant_placements: int = 0  # placements onto a node row outside
                                       # the tenant's own cluster (budget: 0)
     tick_seconds: float = 0.0
@@ -149,6 +151,14 @@ class FleetServer:
         self.prewarmer = BucketPrewarmer()
         self.supervisor = DispatchSupervisor(prewarmer=self.prewarmer)
         self.prewarmer.supervisor = self.supervisor
+        # fleet-level flight recorder (sched/telemetry.py): per-tick phase
+        # spans + per-TENANT stats on each record; storms and abandoned
+        # dispatches auto-dump. Per-pod e2e latency stays per tenant (each
+        # FleetTenant's Scheduler owns its tracker/commit path).
+        from ..sched.telemetry import SchedulerTelemetry
+
+        self.telemetry = SchedulerTelemetry(name="fleet")
+        self.supervisor.event_sink = self.telemetry.note_supervisor_event
         self.stack = FleetStack(mesh=self.mesh)
         self._fleet_dims: Dims = replace(base_dims or Dims(),
                                          has_node_name=False)
@@ -255,6 +265,7 @@ class FleetServer:
             return tick
         for t in tlist:
             tick.per_tenant[t.name] = CycleStats()
+        span = self.telemetry.wave_span("fleet-tick")
 
         # ---- pump + storm seam + pop ---- #
         batches: Dict[str, List] = {}
@@ -266,14 +277,18 @@ class FleetServer:
                 # injected per-tenant watch storm: the tenant's resident
                 # encoding is no longer trusted (full re-encode next tick)
                 # and this tick admits nothing for it — purely ITS
-                # degradation, the other tenants' rows are untouched
+                # degradation, the other tenants' rows are untouched. The
+                # "storm" event makes this a flight-recorder dump trigger:
+                # the degraded tick is explainable from the artifact.
                 t.storm_ticks += 1
                 tick.per_tenant[t.name].degraded += 1
+                self.telemetry.note_supervisor_event("storm", t.name)
                 s.cache.invalidate_snapshot()
                 batches[t.name] = []
                 continue
             batches[t.name] = s.queue.pop_batch(self.batch_size, now=now)
             tick.per_tenant[t.name].attempted = len(batches[t.name])
+        span.mark("pump")
 
         from ..sched.supervisor import DispatchAbandonedError
 
@@ -281,15 +296,17 @@ class FleetServer:
         # failure path must hand them back to their queues — losing them
         # is the one thing a scheduler may never do
         try:
-            out, snaps = self._dispatch_tick(tlist, batches, tick, now)
+            out, snaps = self._dispatch_tick(tlist, batches, tick, now,
+                                             span)
         except DispatchAbandonedError:
             # the abandoned worker's zombie thread may still hold (or be
             # executing on) the resident stacked buffers — never donate or
             # scatter onto them again; the next healthy tick full-restacks
             self.stack.invalidate()
             self._requeue_batches(tlist, batches, tick, now)
+            span.mark("requeue")
             tick.tick_seconds = time.perf_counter() - t0
-            self._finish_tick(tick)
+            self._finish_tick(tick, span)
             return tick
         except Exception:
             # any other post-pop failure (bucket non-convergence, a
@@ -298,14 +315,16 @@ class FleetServer:
             # stack, and re-raise for visibility
             self.stack.invalidate()
             self._requeue_batches(tlist, batches, tick, now)
+            span.mark("requeue")
             tick.tick_seconds = time.perf_counter() - t0
-            self._finish_tick(tick)
+            self._finish_tick(tick, span)
             raise
         tick.dispatches += 1
 
         self._commit_tick(out, tlist, batches, snaps, tick, now)
+        span.mark("bind-commit")
         tick.tick_seconds = time.perf_counter() - t0
-        self._finish_tick(tick)
+        self._finish_tick(tick, span)
         return tick
 
     @staticmethod
@@ -329,12 +348,13 @@ class FleetServer:
                 t.sched.queue.add_prompt_retry(pod, attempts=attempts,
                                                now=now)
 
-    def _dispatch_tick(self, tlist, batches, tick, now):
+    def _dispatch_tick(self, tlist, batches, tick, now, span):
         """Everything between the batch pop and the device result: the
         snapshot convergence round, solo routing, resident stack refresh
         and the ONE vmap'd dispatch. Raises propagate to tick()'s requeue
         guard — this method never loses a popped pod."""
         snaps, keys = self._snapshot_round(tlist, batches)
+        span.mark("snapshot")
 
         # ---- tenants the vmap cannot express run their own single-
         # cluster wave (counted as extra dispatches; the fleet budget
@@ -381,6 +401,7 @@ class FleetServer:
             # per-solo-tenant refresh would leave the others at the old
             # shapes and crash the restack with the batches already popped)
             snaps, keys = self._snapshot_round(tlist, batches)
+            span.mark("solo")
 
         # ---- engine + shared static run bound ---- #
         from ..sched.cycle import _engine, _resolve_rc
@@ -424,6 +445,7 @@ class FleetServer:
             # from host staging; submit() skips the primary while unhealthy.
             self.stack.invalidate()
             Kp = self.stack.padded_k(len(tlist))
+        span.mark("stack-refresh")
         quota = jnp.asarray(self._pad_quota(tlist, Kp), jnp.float32)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -443,6 +465,7 @@ class FleetServer:
                                    fleet=fsig)
         self.supervisor.note_cycle_signature(d, engine, (), False, rc=rc,
                                              fleet=fsig)
+        span.mark("prewarm")
 
         # ---- ONE vmap'd dispatch for the whole fleet ---- #
         stack = self.stack
@@ -497,7 +520,10 @@ class FleetServer:
             (replace(d, has_node_name=False), engine, fsig,
              _mesh_key(self.mesh), rc),
             _primary, _fallback)
-        return handle.result(), snaps
+        span.mark("dispatch")
+        out = handle.result()
+        span.mark("readback")
+        return out, snaps
 
     def _commit_tick(self, out, tlist, batches, snaps, tick, now) -> None:
         """The per-tenant commit loops (PR 4 machinery per tenant): intent
@@ -526,11 +552,12 @@ class FleetServer:
             for i, (pod, attempts) in enumerate(batches[t.name]):
                 if not admitted[k, i]:
                     # quota-clamped, not unschedulable: the pod is fine,
-                    # the tenant's headroom wasn't — defer promptly
+                    # the tenant's headroom wasn't — defer promptly. The
+                    # clamp count rides CycleStats so observe_fleet_tick
+                    # emits the tenant-labelled DRF_CLAMPED series.
                     st.requeued += 1
+                    st.drf_clamped += 1
                     tick.drf_clamped += 1
-                    tick.drf_clamped_by_tenant[t.name] = \
-                        tick.drf_clamped_by_tenant.get(t.name, 0) + 1
                     s.queue.add_prompt_retry(pod, attempts=attempts,
                                              now=now)
                     continue
@@ -565,8 +592,8 @@ class FleetServer:
                 st.failed_keys.append(pod.key)
                 s.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
 
-    def _finish_tick(self, tick: FleetTickStats) -> None:
-        from ..sched.metrics import DRF_CLAMPED, observe_fleet_tick
+    def _finish_tick(self, tick: FleetTickStats, span=None) -> None:
+        from ..sched.metrics import observe_fleet_tick
 
         self.ticks += 1
         self.total_drf_violations += tick.drf_violations
@@ -574,12 +601,25 @@ class FleetServer:
         self.total_drf_clamped += tick.drf_clamped
         self.max_dispatches_per_tick = max(self.max_dispatches_per_tick,
                                            tick.dispatches)
+        # per-tenant attribution happens INSIDE observe_fleet_tick now:
+        # the chaos suite and bench assert tenant isolation (and the DRF
+        # clamp) from the tenant-labelled metrics, routed through
+        # CycleStats — never from FleetServer internals
         observe_fleet_tick(tick.per_tenant)
-        # per-tenant attribution: the chaos suite and bench assert tenant
-        # isolation FROM METRICS, so clamp counts must carry the tenant
-        # label, not a fleet-wide aggregate
-        for name, n in tick.drf_clamped_by_tenant.items():
-            DRF_CLAMPED.inc(n, tenant=name)
+        if span is not None:
+            self.telemetry.finish_wave(
+                span, engine="fleet", dims=self._fleet_dims,
+                fleet={name: {"attempted": st.attempted,
+                              "scheduled": st.scheduled,
+                              "requeued": st.requeued,
+                              "degraded": st.degraded,
+                              "drf_clamped": st.drf_clamped,
+                              "aborted": st.aborted}
+                       for name, st in tick.per_tenant.items()},
+                extra={"dispatches": tick.dispatches,
+                       "drf_violations": tick.drf_violations,
+                       "cross_tenant_placements":
+                           tick.cross_tenant_placements})
 
     def run_until_idle(self, max_ticks: int = 64,
                        stall_ticks: int = 2) -> FleetTickStats:
@@ -608,6 +648,7 @@ class FleetServer:
                 agg.aborted += st.aborted
                 agg.requeued += st.requeued
                 agg.degraded += st.degraded
+                agg.drf_clamped += st.drf_clamped
                 agg.assignments.update(st.assignments)
             if all(t.sched.queue.lengths()[0] == 0
                    for t in self.tenants.values()):

@@ -68,11 +68,28 @@ DRF_CLAMPED = REG.counter(
     "scheduler_fleet_drf_clamped_total",
     "Pending pods clamped inert by the DRF quota pre-mask",
     labels=("tenant",))
+# ISSUE 7 flight-recorder + e2e latency (sched/telemetry.py): the per-pod
+# watch→bind histogram ROADMAP item 2's p99 target is defined in. Buckets
+# are finer than the default ladder below 250 ms — that is where the
+# micro-wave work will live — and extend to 60 s so today's cycle-granular
+# baseline still lands inside a bounded bucket.
+POD_E2E_LATENCY = REG.histogram(
+    "scheduler_pod_e2e_latency_seconds",
+    "Per-pod end-to-end latency: informer ingest / queue add (first seen, "
+    "surviving requeues) to Binding commit",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15,
+             0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+FLIGHT_DUMPS = REG.counter(
+    "scheduler_flight_recorder_dumps_total",
+    "Flight-recorder ring dumps, by trigger (abandoned, watchdog_timeout, "
+    "degraded, storm, takeover, debug-endpoint, ...)", labels=("trigger",))
 
 
 def observe_fleet_tick(per_tenant) -> None:
     """Record one fleet tick's per-tenant outcomes (fleet/server.py calls
-    this with {tenant name → CycleStats})."""
+    this with {tenant name → CycleStats}). DRF clamp counts route through
+    CycleStats.drf_clamped so the fleet bench asserts `drf_clamped >= 1`
+    from the metric, not from FleetServer internals."""
     for name, st in per_tenant.items():
         if st.scheduled:
             TENANT_ADMITTED.inc(st.scheduled, tenant=name)
@@ -80,6 +97,8 @@ def observe_fleet_tick(per_tenant) -> None:
             TENANT_REQUEUED.inc(st.requeued, tenant=name)
         if st.degraded:
             TENANT_DEGRADED.inc(st.degraded, tenant=name)
+        if getattr(st, "drf_clamped", 0):
+            DRF_CLAMPED.inc(st.drf_clamped, tenant=name)
 
 
 def observe_wave(stats, queue_lengths, cache_counts) -> None:

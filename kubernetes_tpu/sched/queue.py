@@ -60,6 +60,13 @@ class PriorityQueue:
         # (apis/config/types.go:96-101) — config-surface overridable
         self.initial_backoff = initial_backoff
         self.max_backoff = max_backoff
+        # e2e-latency ingest stamps (sched/telemetry.py PodLatencyTracker,
+        # attached by the Scheduler): every admission path stamps the pod's
+        # FIRST-seen time — requeues are idempotent no-ops, so the recorded
+        # watch→bind span survives backoff/prompt-retry/crash-recovery
+        # round-trips. The tracker never calls back into the queue, so
+        # stamping under `_mu` cannot deadlock.
+        self.tracker = None
         self._mu = threading.Lock()
         self._cond = threading.Condition(self._mu)
         self._seq = itertools.count()
@@ -100,9 +107,14 @@ class PriorityQueue:
     # Pop/Update/Delete/MoveAllToActiveQueue)
     # ------------------------------------------------------------------ #
 
+    def _stamp(self, key: str, now: float) -> None:
+        if self.tracker is not None:
+            self.tracker.stamp(key, now)
+
     def add(self, pod: Pod, now: float = 0.0) -> None:
         """Add a new pending pod straight to activeQ."""
         with self._mu:
+            self._stamp(pod.key, now)
             self._delete_everywhere(pod.key)
             self._push_active(_Entry(pod=pod, timestamp=now))
 
@@ -114,6 +126,7 @@ class PriorityQueue:
         was popped in (cluster state changed mid-flight), it goes to backoffQ
         for a prompt retry instead of parking in unschedulableQ."""
         with self._mu:
+            self._stamp(pod.key, now)
             if pod.key in self._active_keys or pod.key in self._backoff_keys:
                 return
             e = _Entry(pod=pod, attempts=attempts, timestamp=now)
@@ -140,6 +153,7 @@ class PriorityQueue:
         queue position; an unschedulable pod whose spec changed may now fit,
         so it moves to activeQ."""
         with self._mu:
+            self._stamp(pod.key, now)
             e = self._delete_everywhere(pod.key)
             attempts = e.attempts if e else 0
             self._push_active(_Entry(pod=pod, attempts=attempts, timestamp=now))
@@ -148,6 +162,11 @@ class PriorityQueue:
         with self._mu:
             self._delete_everywhere(key)
             self._nominated.pop(key, None)
+            if self.tracker is not None:
+                # a deleted pending pod's watch→bind span never completes;
+                # the scheduler's commit path pops bound pods' stamps itself
+                # (queue.delete is NOT on the bind path)
+                self.tracker.discard(key)
 
     def pop_batch(self, max_n: int, now: float = 0.0) -> List[Tuple[Pod, int]]:
         """Drain up to max_n pods from activeQ in comparator order. Returns
@@ -177,6 +196,7 @@ class PriorityQueue:
         zero-victim (filter-discrepancy) case gets at most one prompt
         retry per pod (Preemptor._zero_victim_retries)."""
         with self._mu:
+            self._stamp(pod.key, now)
             if pod.key in self._active_keys or pod.key in self._backoff_keys:
                 return
             self._unschedulable.pop(pod.key, None)
@@ -203,6 +223,7 @@ class PriorityQueue:
         history for the NEXT failure. Returns the lane the pod ended in
         ("active" always) — callers assert, tests introspect via lanes()."""
         with self._mu:
+            self._stamp(pod.key, now)
             if pod.key in self._active_keys:
                 return "active"
             e = self._backoff_keys.pop(pod.key, None)

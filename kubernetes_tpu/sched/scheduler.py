@@ -82,6 +82,11 @@ class CycleStats:
     # counters instead of scraping logs.
     requeued: int = 0
     degraded: int = 0
+    # pods deferred by the DRF quota pre-mask this tick (fleet/server.py;
+    # a subset of `requeued`) — routed through sched/metrics.py
+    # observe_fleet_tick so the fleet bench asserts the clamp from the
+    # tenant-labelled DRF_CLAMPED counter, not from server internals
+    drf_clamped: int = 0
     cycle_seconds: float = 0.0
     assignments: Dict[str, str] = field(default_factory=dict)
     # pod keys that failed this wave (feeds FailedScheduling events)
@@ -188,6 +193,17 @@ class Scheduler:
         self.supervisor = DispatchSupervisor(prewarmer=self.prewarmer,
                                              mesh_state=self.mesh_state)
         self.prewarmer.supervisor = self.supervisor
+        # observability (sched/telemetry.py, ISSUE 7): per-pod watch→bind
+        # latency (stamps in THIS scheduler's clock domain via the queue's
+        # tracker hook), per-wave phase spans + flight-recorder ring, and
+        # the supervisor's event narration. KTPU_TELEMETRY=0 disables all
+        # of it (the bench overhead baseline).
+        from .telemetry import SchedulerTelemetry
+
+        self.telemetry = SchedulerTelemetry(name=scheduler_name)
+        if self.telemetry.enabled:
+            self.queue.tracker = self.telemetry.tracker
+        self.supervisor.event_sink = self.telemetry.note_supervisor_event
 
     @staticmethod
     def _make_mesh_state(mesh):
@@ -297,12 +313,53 @@ class Scheduler:
         are in cache.scheduled_pods() for the next snapshot)."""
         now = self.clock() if now is None else now
         t0 = time.perf_counter()
+        # per-wave phase spans (sched/telemetry.py): each mark() closes the
+        # phase that just ran; the record feeds the per-operation histogram
+        # and the flight-recorder ring (no-op span when KTPU_TELEMETRY=0)
+        span = self.telemetry.wave_span()
+        ctx: Dict[str, object] = {}
+        try:
+            return self._run_wave(span, now, t0, ctx)
+        except Exception:
+            # a wave that DIES mid-flight is exactly the tick the flight
+            # recorder exists to explain: record what ran before the raise
+            # (and the supervisor events that would otherwise leak onto
+            # the next wave's record), dump, and re-raise. InjectedCrash
+            # (BaseException — the SIGKILL analog) punches through
+            # unrecorded, as a real kill would.
+            stats = ctx.get("stats") or CycleStats()
+            stats.cycle_seconds = time.perf_counter() - t0
+            span.mark("exception")
+            self.telemetry.finish_wave(
+                span, stats=stats, engine=ctx.get("engine", ""),
+                dims=ctx.get("dims"), rc=ctx.get("rc", 0),
+                extra={"exception": True})
+            if self.telemetry.enabled:
+                self.telemetry.dump("exception")
+            raise
+
+    def _drain_idle_events(self, span, stats) -> None:
+        """Supervisor events (a prewarm compile failure, a prober
+        recovery) can land while the queue is idle; an idle/early-return
+        wave must still drain them into a record — auto-dumping on a
+        trigger — instead of leaving them to be misattributed to the next
+        busy wave. Event-free idle waves record nothing, so the ring
+        stays signal."""
+        if self.telemetry.has_pending_events():
+            span.mark("idle")
+            self.telemetry.finish_wave(span, stats=stats, engine="idle")
+
+    def _run_wave(self, span, now: float, t0: float,
+                  ctx: Dict[str, object]) -> CycleStats:
         self.queue.pump(now)
         self.cache.cleanup(now)
         self.expire_waiting(now)
+        span.mark("pump")
         batch = self.queue.pop_batch(self.batch_size, now=now)
         cycle = self.queue.current_cycle()
+        span.mark("pop")
         stats = CycleStats(attempted=len(batch))
+        ctx["stats"] = stats
 
         # pods an extender is interested in take the per-pod extender path
         # after the batched wave (they must see the wave's assumes)
@@ -314,15 +371,21 @@ class Scheduler:
             batch = [(p, a) for p, a in batch if p.key not in ext_keys]
 
         if not batch and not ext_batch:
+            self._drain_idle_events(span, stats)
             return stats
         if not batch:
             for pod, attempts in ext_batch:
                 self._schedule_one_with_extenders(pod, attempts, now, cycle, stats)
             stats.cycle_seconds = time.perf_counter() - t0
+            # an extender-only wave did REAL work (per-pod dispatches that
+            # can degrade/abandon): it gets its own record, never "idle"
+            span.mark("extenders")
+            self.telemetry.finish_wave(span, stats=stats, engine="extenders")
             return stats
 
         pending = [p for p, _ in batch]
         snap, keys = self._snapshot_keys(pending)
+        span.mark("snapshot")
         extras = tuple(p for p, _ in self._extra_score)
         extra_w = tuple(w for _, w in self._extra_score)
         from dataclasses import replace as _dc_replace
@@ -341,6 +404,7 @@ class Scheduler:
             rc = snap.runs.rc
             stats.class_runs = snap.runs.n_runs
             stats.collapse_ratio = round(snap.runs.collapse_ratio, 2)
+        ctx.update(engine=wave_engine, dims=snap.dims, rc=rc)
         self.prewarmer.observe(
             snap.dims, n_nodes=self.cache.node_count,
             n_existing=self.cache.pod_count,
@@ -350,8 +414,9 @@ class Scheduler:
             mesh=snap.mesh, rc=rc)
         self.supervisor.note_cycle_signature(
             snap.dims, wave_engine, extras, gang_arg is not None, rc=rc)
+        span.mark("prewarm")
 
-        def _primary():
+        def _dispatch():
             res = _schedule_batch(
                 snap.tables, snap.pending, keys, snap.dims.D, snap.existing,
                 has_node_name=snap.dims.has_node_name,
@@ -360,7 +425,28 @@ class Scheduler:
                 extra_plugins=extras, extra_weights=extra_w,
                 gang=gang_arg, dims=snap.dims, prewarmer=self.prewarmer,
                 mesh=snap.mesh, runs=snap.runs)
-            return jax.device_get(res.node)
+            return res.node
+
+        def _primary():
+            tel = self.telemetry
+            if not tel.enabled:
+                return jax.device_get(_dispatch())
+            # tier-3 device-time split (runs on the watchdog worker):
+            # launch (trace + async enqueue) vs XLA execution
+            # (block_until_ready) vs host readback (device_get) — the
+            # encode/upload half of the ratio is the wave's snapshot span.
+            # KTPU_PROFILE additionally brackets this in a jax.profiler
+            # TraceAnnotation inside a lazily-started profiler trace.
+            with tel.device_annotation("ktpu-wave-dispatch"):
+                tp0 = time.perf_counter()
+                node = _dispatch()
+                tp1 = time.perf_counter()
+                jax.block_until_ready(node)
+                tp2 = time.perf_counter()
+                out = jax.device_get(node)
+            tel.note_device_split(tp1 - tp0, tp2 - tp1,
+                                  time.perf_counter() - tp2, token=span)
+            return out
 
         # the commit loop must map node indices through the node_order of
         # the snapshot that was ACTUALLY dispatched: a fallback re-encode
@@ -463,9 +549,12 @@ class Scheduler:
                         pass           # take down the wave
             from .supervisor import DispatchAbandonedError
 
+            span.mark("dispatch")
             try:
                 node_idx = handle.result()
+                span.mark("readback")
             except DispatchAbandonedError:
+                span.mark("readback")
                 # crash-consistent wave abort: the dispatch died on BOTH
                 # backends before any readback, so nothing was assumed and
                 # nothing may be committed — forget the wave cleanly and
@@ -481,7 +570,13 @@ class Scheduler:
                     stats.aborted += 1
                     self.queue.add_prompt_retry(pod, attempts=attempts,
                                                 now=now)
+                span.mark("requeue")
                 stats.cycle_seconds = time.perf_counter() - t0
+                # the supervisor's "abandoned" event auto-dumps the ring:
+                # the dead tick is reconstructable from the artifact
+                self.telemetry.finish_wave(span, stats=stats,
+                                           engine=wave_engine,
+                                           dims=snap.dims, rc=rc)
                 return stats
         finally:
             # the dispatch no longer holds the snapshot's arrays — the
@@ -519,9 +614,12 @@ class Scheduler:
                 self.queue.add_prompt_retry(pod, attempts=attempts, now=now)
             commits = []
             intent = None
+        span.mark("intent-write")
         for pod, node_name, attempts in commits:
             self._commit(pod, node_name, attempts, now, cycle, stats)
+        span.mark("bind-commit")
         self._retire_intent(intent)
+        span.mark("retire")
 
         # ---- preemption pass: AFTER commits, against ONE fresh snapshot so
         # the what-if sees pods assumed earlier in this very wave (otherwise
@@ -555,7 +653,10 @@ class Scheduler:
         for pod, attempts in ext_batch:
             self._schedule_one_with_extenders(pod, attempts, now, cycle, stats)
 
+        span.mark("requeue")
         stats.cycle_seconds = time.perf_counter() - t0
+        self.telemetry.finish_wave(span, stats=stats, engine=wave_engine,
+                                   dims=snap.dims, rc=rc)
         return stats
 
     def _schedule_one_with_extenders(
@@ -780,6 +881,9 @@ class Scheduler:
             ok = False
         if ok:
             self.cache.finish_binding(pod.key, now)
+            # close the span BEFORE queue.delete discards the stamp (the
+            # recovered pod may still sit in a queue lane on this side)
+            self.telemetry.record_bound(pod.key, now)
             self.queue.delete(pod.key)
             return True
         self.cache.forget_pod(pod.key)
@@ -898,6 +1002,14 @@ class Scheduler:
 
         if ok:
             self.cache.finish_binding(pod.key, now)
+            # e2e watch→bind: close the pod's first-seen span (stamped at
+            # queue admission) in the scheduler's clock domain — at the
+            # clock's CURRENT reading, not the wave-entry `now`: the
+            # binding wave's own snapshot/dispatch/commit time is part of
+            # the span being claimed (under a per-tick deterministic
+            # clock the two readings coincide, so virtual latencies are
+            # unchanged)
+            self.telemetry.record_bound(pod.key, self.clock())
             stats.scheduled += 1
             stats.assignments[pod.key] = node_name
             if fw is not None and state is not None:
@@ -943,6 +1055,7 @@ class Scheduler:
         ok = self._run_bind(state, pod, node_name, binder_ext)
         if ok:
             self.cache.finish_binding(key, now)
+            self.telemetry.record_bound(key, now)
             fw.run_post_bind_plugins(state, pod, node_name)
             return True
         self.waiting_bind_errors += 1

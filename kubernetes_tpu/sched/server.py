@@ -83,6 +83,78 @@ class APIBinder:
             return False
 
 
+class TelemetryGateway:
+    """Scheduler-side scrape point (ISSUE 7): the apiserver already serves
+    the shared registry at its /metrics, but the scheduler is its own
+    process in production — it needs its own exposition. Serves
+
+      /metrics               component/metrics.py text format (the shared
+                             DEFAULT_REGISTRY: scheduler_* series included)
+      /debug/flightrecorder  the flight-recorder ring as structured JSON
+                             (read-only: the same document shape an
+                             auto-dump writes, with none of the dump
+                             side effects)
+      /healthz               "ok"
+
+    on a daemonized stdlib HTTP server; port 0 binds an ephemeral port."""
+
+    def __init__(self, telemetry, host: str = "127.0.0.1", port: int = 0):
+        import http.server
+        import json as _json
+        import socketserver
+
+        from kubernetes_tpu.component.metrics import DEFAULT_REGISTRY
+
+        tel = telemetry
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: ARG002 - silence stdlib
+                pass
+
+            def do_GET(self):  # noqa: N802 - stdlib handler name
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = DEFAULT_REGISTRY.expose_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif path == "/debug/flightrecorder":
+                    # read-only: a scrape loop must not clobber last_dump,
+                    # count as a dump, or write KTPU_FLIGHT_DIR files
+                    body = _json.dumps(
+                        tel.snapshot_doc("debug-endpoint"), indent=1).encode()
+                    ctype = "application/json"
+                elif path == "/healthz":
+                    body, ctype = b"ok", "text/plain"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+            daemon_threads = True
+
+        self._httpd = _Server((host, port), _Handler)
+        self.host, self.port = self._httpd.server_address
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="scheduler-telemetry-http",
+                                        daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "TelemetryGateway":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
 def restrict_pod_nodes(pod: Pod, allowed: frozenset) -> Pod:
     """AND a node-name restriction into the pod's required node affinity by
     adding matchFields(metadata.name IN allowed) to every term (or one fresh
@@ -115,7 +187,8 @@ class SchedulerServer:
                  base_dims=None,
                  ledger=None,
                  lease_config: Optional[Dict[str, Any]] = None,
-                 standby_warm_interval: float = 2.0):
+                 standby_warm_interval: float = 2.0,
+                 telemetry_port: Optional[int] = None):
         from kubernetes_tpu.state.dims import Dims
 
         # ComponentConfig / Policy surface (apis/config/types.go:45-112 →
@@ -248,6 +321,10 @@ class SchedulerServer:
         self._crashed = False
         self.total_scheduled = 0
         self.total_unschedulable_events = 0
+        # scheduler-side /metrics + /debug/flightrecorder exposition
+        # (TelemetryGateway): None = off, 0 = ephemeral port, N = fixed
+        self.telemetry_port = telemetry_port
+        self.telemetry_gateway: Optional[TelemetryGateway] = None
 
     # -- conversion --------------------------------------------------------- #
 
@@ -352,6 +429,9 @@ class SchedulerServer:
 
         self.comparer = CacheComparer(self.scheduler.cache, self.client)
         install_sigusr2(self.comparer)
+        if self.telemetry_port is not None:
+            self.telemetry_gateway = TelemetryGateway(
+                self.scheduler.telemetry, port=self.telemetry_port).start()
         t = threading.Thread(target=self._loop, daemon=True,
                              name="scheduler-loop")
         t.start()
@@ -368,6 +448,10 @@ class SchedulerServer:
                 inf.stop()
         for t in self._threads:
             t.join(timeout=2)
+        if self.telemetry_gateway is not None:
+            self.telemetry_gateway.stop()
+            self.telemetry_gateway = None
+        self.scheduler.telemetry.stop_profile()
 
     def crash(self) -> None:
         """Simulated abrupt process death (restart drills, bench failover
@@ -439,6 +523,10 @@ class SchedulerServer:
                         self.last_recovery = self.scheduler.recover(
                             lookup=self._lookup_pod)
                         self.takeovers += 1
+                        # a takeover is a flight-recorder trigger: the ring
+                        # at this moment explains what the interim leader's
+                        # waves looked like when the lease changed hands
+                        self.scheduler.telemetry.dump("takeover")
                     except Exception as e:  # noqa: BLE001 - a failed
                         # recovery pass leaves the intents unretired for
                         # the next one; scheduling proceeds (pods are

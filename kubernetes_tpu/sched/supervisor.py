@@ -162,6 +162,13 @@ class DispatchSupervisor:
         # tenant-axis mesh so the rewarmed executable lands under the SAME
         # key the live fleet dispatch looks up (fleet/cycle.py)
         self.mesh_provider: Optional[Callable[[], Any]] = None
+        # flight-recorder event sink (sched/telemetry.py
+        # SchedulerTelemetry.note_supervisor_event): every health
+        # transition / fallback / abandonment is narrated to the wave
+        # record in flight, so a degraded tick is explainable from the
+        # dump artifact. Called from the serving loop AND worker threads;
+        # a raising sink must never take the ladder down.
+        self.event_sink: Optional[Callable[[str, str], None]] = None
         self.stats = SupervisorStats()
         self._mu = threading.Lock()
         self._healthy = True
@@ -241,7 +248,17 @@ class DispatchSupervisor:
         the stacked executable, not the single-cluster one."""
         self._cycle_sig = (dims, engine, extras, gang, rc, fleet)
 
+    def _emit(self, kind: str, detail: str = "") -> None:
+        sink = self.event_sink
+        if sink is None:
+            return
+        try:
+            sink(kind, detail)
+        except Exception:  # noqa: BLE001 - telemetry never breaks dispatch
+            pass
+
     def _mark_unhealthy(self, reason: str) -> None:
+        self._emit("degraded", reason)
         with self._mu:
             self.stats.last_failure = reason
             if not self._healthy:
@@ -362,6 +379,7 @@ class DispatchSupervisor:
             if self._healthy:
                 return
             self._healthy = True
+            self._emit("recovery", self.stats.last_failure)
             self.stats.recoveries += 1
             if self.stats.unhealthy_since is not None:
                 self.stats.last_recovery_s = round(
@@ -391,6 +409,7 @@ class DispatchSupervisor:
                                          gang=gang, mesh=mesh, rc=rc,
                                          fleet=fleet):
                     self.stats.rewarms += 1
+                    self._emit("rewarm", f"{engine} rc={rc}")
             except Exception:  # noqa: BLE001 - rewarm is an optimization
                 pass
 
@@ -470,6 +489,8 @@ class DispatchSupervisor:
             # execution), mark the backend lost, degrade
             h._abandoned.set()
             self.stats.watchdog_timeouts += 1
+            self._emit("watchdog_timeout",
+                       f"{h.kind} exceeded {h.deadline:.3g}s")
             self._mark_unhealthy(
                 f"{h.kind} dispatch exceeded {h.deadline:.3g}s deadline")
             return self._run_fallback(
@@ -501,6 +522,7 @@ class DispatchSupervisor:
         dev = self._fallback_dev()
         if h.fallback is None or dev is None:
             self.stats.abandoned += 1
+            self._emit("abandoned", f"{h.kind}: no fallback ({reason})")
             raise DispatchAbandonedError(
                 f"{h.kind} dispatch abandoned ({reason}); no fallback "
                 f"available")
@@ -515,10 +537,13 @@ class DispatchSupervisor:
             out = h.fallback(dev, hung)
         except Exception as e:  # noqa: BLE001 - the ladder ends here
             self.stats.abandoned += 1
+            self._emit("abandoned",
+                       f"{h.kind}: primary ({reason}), fallback ({e!r})")
             raise DispatchAbandonedError(
                 f"{h.kind} dispatch abandoned: primary failed ({reason}), "
                 f"fallback failed ({e!r})") from e
         self.stats.fallback_dispatches += 1
+        self._emit("fallback", f"{h.kind}: {reason}")
         if h.kind == "cycle":
             self.stats.degraded_cycles += 1
             if len(self.stats.degraded_cycle_seconds) < 1024:

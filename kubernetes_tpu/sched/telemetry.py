@@ -1,0 +1,402 @@
+"""Flight recorder + end-to-end latency telemetry for the serving scheduler.
+
+Three tiers (ISSUE 7; docs/OBSERVABILITY.md is the operator-facing manual):
+
+  1. **Per-pod e2e latency** — every pending pod is stamped at informer-
+     ingest/queue-add time (`PodLatencyTracker`, first-seen semantics: a
+     backoff requeue, a prompt retry or a crash-recovery re-admission keeps
+     the ORIGINAL stamp) and recorded at Binding-commit into the
+     `scheduler_pod_e2e_latency_seconds` histogram — the metric ROADMAP
+     item 2's "p99 watch→bind < 100 ms" target is defined in. Stamps live
+     in the *scheduler's* clock domain (the injected, possibly
+     deterministic per-tick clock), so tests and the mesh/fleet
+     bit-equality suites measure exact virtual latencies.
+  2. **Per-wave phase spans** — `SchedulerTelemetry.wave_span()` wraps a
+     `component/trace.py` Trace (injected clock) around one serving wave;
+     the scheduler marks pump → pop → snapshot → prewarm → dispatch →
+     readback → intent-write → bind-commit → retire, each span feeding the
+     `scheduler_scheduling_duration_seconds{operation=<phase>}` histogram
+     and the bounded in-memory **flight recorder ring**. Supervisor events
+     (degraded / fallback / watchdog_timeout / abandoned / rewarm /
+     recovery — sched/supervisor.py `event_sink`) and per-tenant fleet
+     stats attach to the wave record, and the ring dumps structured JSON
+     on demand (`/debug/flightrecorder`, `dump()`) and automatically on an
+     abandoned dispatch, a watchdog budget violation, a tenant storm or a
+     takeover — a bad tick in bench/chaos is explainable from the
+     artifact, not from logs.
+  3. **Device-time split** — the primary dispatch separately times XLA
+     launch (trace+enqueue) vs execution (`block_until_ready`) vs readback
+     (`device_get`), so host-pipeline-overlap regressions show up as a
+     ratio; `KTPU_PROFILE=<dir>` additionally starts a `jax.profiler`
+     trace with per-wave `TraceAnnotation` markers.
+
+Kill switch: ``KTPU_TELEMETRY=0`` turns every tier into a no-op (the
+`latency` bench stage uses it to bound telemetry overhead at <2% of the
+untelemetered flagship pods/s).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..component.trace import Trace
+from .metrics import FLIGHT_DUMPS, POD_E2E_LATENCY, SCHEDULING_DURATION
+
+#: supervisor/tick event kinds that auto-dump the ring when they appear on
+#: a wave record (the "explainable without logs" triggers of ISSUE 7),
+#: most severe first — the dump is labelled with the worst event present
+DUMP_TRIGGERS = ("abandoned", "watchdog_timeout", "storm", "degraded")
+
+#: canonical serving-wave phase order (the scheduler marks a subset; fleet
+#: ticks add stack-refresh/solo phases) — tests assert ordering against it
+WAVE_PHASES = ("pump", "pop", "snapshot", "prewarm", "dispatch", "readback",
+               "intent-write", "bind-commit", "retire", "requeue")
+
+
+class PodLatencyTracker:
+    """First-seen ingest stamps, keyed by pod key, in the caller's clock
+    domain. `stamp` is idempotent — requeues (backoff, prompt retry,
+    crash-recovery re-admission) keep the ORIGINAL stamp, so the recorded
+    latency is the true watch→bind span, not the last-retry span."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._first_seen: Dict[str, float] = {}
+
+    def stamp(self, key: str, now: float) -> None:
+        with self._mu:
+            self._first_seen.setdefault(key, now)
+
+    def first_seen(self, key: str) -> Optional[float]:
+        with self._mu:
+            return self._first_seen.get(key)
+
+    def discard(self, key: str) -> None:
+        """Pod deleted while pending — the span will never complete."""
+        with self._mu:
+            self._first_seen.pop(key, None)
+
+    def pop_latency(self, key: str, now: float) -> Optional[float]:
+        """Binding committed: consume the stamp, return the e2e span."""
+        with self._mu:
+            t0 = self._first_seen.pop(key, None)
+        return None if t0 is None else max(now - t0, 0.0)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._first_seen)
+
+
+class FlightRecorder:
+    """Bounded ring of wave/tick records. Append-only; `dump()` snapshots
+    the ring into one structured-JSON document (optionally to a file)."""
+
+    def __init__(self, capacity: int = 64) -> None:
+        self.capacity = capacity
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self.evicted = 0  # records pushed out of the ring
+
+    def record(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        with self._mu:
+            self._seq += 1
+            rec["seq"] = self._seq
+            if len(self._ring) == self.capacity:
+                self.evicted += 1
+            self._ring.append(rec)
+        return rec
+
+    def records(self) -> List[Dict[str, Any]]:
+        with self._mu:
+            return list(self._ring)
+
+    def snapshot(self, trigger: str) -> Dict[str, Any]:
+        with self._mu:
+            return {
+                "trigger": trigger,
+                "capacity": self.capacity,
+                "evicted": self.evicted,
+                "last_seq": self._seq,
+                "records": [dict(r) for r in self._ring],
+            }
+
+
+class _NullSpan:
+    """No-op span when telemetry is disabled (KTPU_TELEMETRY=0)."""
+
+    __slots__ = ()
+    enabled = False
+
+    def mark(self, name: str) -> None:  # noqa: ARG002 - interface
+        pass
+
+
+class _WaveSpan:
+    """One serving wave's phase timeline: a component/trace.py Trace with
+    the telemetry clock injected. `mark(name)` closes the phase that just
+    ran; phase durations are derived from consecutive steps."""
+
+    __slots__ = ("trace",)
+    enabled = True
+
+    def __init__(self, clock: Callable[[], float], name: str,
+                 threshold: float) -> None:
+        self.trace = Trace(name, clock=clock, threshold=threshold)
+
+    def mark(self, name: str) -> None:
+        self.trace.step(name)
+
+    def phases(self) -> List[Tuple[str, float]]:
+        out: List[Tuple[str, float]] = []
+        prev = self.trace.start
+        for ts, msg in self.trace.steps:
+            out.append((msg, max(ts - prev, 0.0)))
+            prev = ts
+        return out
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class SchedulerTelemetry:
+    """The scheduler-wide observability layer: one per Scheduler (and one
+    per FleetServer). Thread-aware: supervisor events and the device-time
+    split arrive from watchdog worker threads; everything else runs on the
+    serving loop."""
+
+    def __init__(self, name: str = "scheduler", capacity: int = 64,
+                 clock: Callable[[], float] = time.perf_counter,
+                 enabled: Optional[bool] = None,
+                 slow_wave_threshold: float = 30.0) -> None:
+        if enabled is None:
+            enabled = os.environ.get("KTPU_TELEMETRY", "1") not in ("0", "off")
+        self.name = name
+        self.enabled = enabled
+        self.clock = clock
+        self.slow_wave_threshold = slow_wave_threshold
+        self.tracker = PodLatencyTracker()
+        self.recorder = FlightRecorder(capacity)
+        # exact-quantile reservoir beside the Prometheus histogram: the
+        # latency bench and tests read precise p50/p99 from here while
+        # dashboards use histogram_quantile on the exposed buckets
+        self.latency_samples: deque = deque(maxlen=8192)
+        self._mu = threading.Lock()
+        self._pending_events: List[Tuple[str, str]] = []
+        # token (wave span) → readings; see note_device_split. Keyed by
+        # the token OBJECT (strong ref — an id() key could be reused by a
+        # GC'd span), bounded below so abandoned waves' entries can't leak
+        self._device_split: Dict[object, Dict[str, float]] = {}
+        self.last_dump: Optional[Dict[str, Any]] = None
+        self.dumps = 0
+        # KTPU_PROFILE=<dir>: jax.profiler trace capture around dispatches
+        self.profile_dir = os.environ.get("KTPU_PROFILE") or None
+        self._profiling = False
+
+    # ------------------------------------------------------------------ #
+    # tier 1: per-pod e2e latency (watch→bind)
+    # ------------------------------------------------------------------ #
+
+    def record_bound(self, key: str, now: float) -> Optional[float]:
+        """Binding-commit: close the pod's watch→bind span and feed the
+        e2e histogram. `now` must be in the SAME clock domain the queue
+        stamped with (the scheduler's injected clock)."""
+        if not self.enabled:
+            return None
+        lat = self.tracker.pop_latency(key, now)
+        if lat is None:
+            return None
+        POD_E2E_LATENCY.observe(lat)
+        with self._mu:
+            # under _mu: the debug endpoint's quantile read iterates the
+            # deque from the gateway thread, and a concurrent append would
+            # raise "deque mutated during iteration"
+            self.latency_samples.append(lat)
+        return lat
+
+    def latency_quantiles(self, qs=(0.5, 0.99)) -> Dict[float, float]:
+        """Exact quantiles (seconds) over the bounded sample reservoir."""
+        with self._mu:
+            samples = sorted(self.latency_samples)
+        if not samples:
+            return {q: 0.0 for q in qs}
+        return {q: samples[min(int(q * len(samples)), len(samples) - 1)]
+                for q in qs}
+
+    # ------------------------------------------------------------------ #
+    # tier 2: wave spans + flight recorder
+    # ------------------------------------------------------------------ #
+
+    def wave_span(self, name: str = "wave"):
+        if not self.enabled:
+            return _NULL_SPAN
+        return _WaveSpan(self.clock, name, self.slow_wave_threshold)
+
+    def has_pending_events(self) -> bool:
+        with self._mu:
+            return bool(self._pending_events)
+
+    def note_supervisor_event(self, kind: str, detail: str = "") -> None:
+        """sched/supervisor.py `event_sink`: called from the serving loop
+        AND from watchdog/prober threads — events accumulate until the
+        current wave's `finish_wave` drains them onto its record."""
+        if not self.enabled:
+            return
+        with self._mu:
+            self._pending_events.append((kind, str(detail)[:200]))
+
+    def note_device_split(self, launch: float, execute: float,
+                          readback: float, token: object = None) -> None:
+        """Tier 3 readings from the dispatch worker: XLA launch vs device
+        execution vs host readback for the wave in flight. `token` is the
+        wave's span: a watchdog-ABANDONED primary's zombie thread may
+        finish minutes later and report its timings — keyed to its own
+        (long-finished) span they can neither attach to a later wave's
+        record nor clobber that wave's own pending reading."""
+        if not self.enabled:
+            return
+        with self._mu:
+            if len(self._device_split) >= 8:
+                # stale entries from abandoned waves whose spans never
+                # finished — drop them all rather than leak
+                self._device_split.clear()
+            self._device_split[token] = {
+                "launch_s": round(launch, 6),
+                "execute_s": round(execute, 6),
+                "readback_s": round(readback, 6),
+            }
+
+    def finish_wave(self, span, *, stats=None, engine: str = "",
+                    dims=None, rc: int = 0,
+                    fleet: Optional[Dict[str, Any]] = None,
+                    extra: Optional[Dict[str, Any]] = None) -> Optional[Dict]:
+        """Close one wave: derive phase durations, feed the per-phase
+        histograms, attach drained supervisor events + device split, ring
+        the record, and auto-dump when a trigger event is present."""
+        if not self.enabled or not getattr(span, "enabled", False):
+            return None
+        phases = span.phases()
+        for phase, dt in phases:
+            SCHEDULING_DURATION.observe(dt, operation=phase)
+        with self._mu:
+            events, self._pending_events = self._pending_events, []
+            # this wave's own reading (or an untokened caller's); entries
+            # keyed to OTHER spans are abandoned waves' zombie reports —
+            # left behind and bounded-cleared by note_device_split
+            split = self._device_split.pop(span, None) \
+                or self._device_split.pop(None, None)
+        rec: Dict[str, Any] = {
+            "recorder": self.name,
+            "t_start": round(span.trace.start, 6),
+            "duration_s": round(span.trace.duration(), 6),
+            "phases": [(p, round(dt, 6)) for p, dt in phases],
+            "engine": engine,
+            "rc": rc,
+        }
+        if dims is not None:
+            rec["bucket"] = {"N": dims.N, "P": dims.P, "E": dims.E,
+                             "D": dims.D}
+        if stats is not None:
+            rec["stats"] = {
+                "attempted": stats.attempted,
+                "scheduled": stats.scheduled,
+                "unschedulable": stats.unschedulable,
+                "bind_errors": stats.bind_errors,
+                "aborted": stats.aborted,
+                "requeued": getattr(stats, "requeued", 0),
+                "degraded": getattr(stats, "degraded", 0),
+            }
+        if events:
+            rec["supervisor_events"] = events
+        if split is not None:
+            rec["device_split"] = split
+        if fleet is not None:
+            rec["fleet"] = fleet
+        if extra:
+            rec.update(extra)
+        self.recorder.record(rec)
+        span.trace.log_if_long(self.slow_wave_threshold)
+        present = {k for k, _ in events}
+        trigger = next((t for t in DUMP_TRIGGERS if t in present), None)
+        if trigger is not None:
+            self.dump(trigger)
+        return rec
+
+    def snapshot_doc(self, trigger: str) -> Dict[str, Any]:
+        """The dump DOCUMENT without the dump SIDE EFFECTS — what a
+        read-only consumer (the /debug/flightrecorder endpoint) serves. A
+        scrape loop must neither clobber `last_dump` (the incident
+        artifact an auto-dump left behind), count as a dump, nor write
+        KTPU_FLIGHT_DIR files."""
+        doc = self.recorder.snapshot(trigger)
+        doc["recorder"] = self.name
+        q = self.latency_quantiles()
+        doc["latency_p50_s"] = round(q[0.5], 6)
+        doc["latency_p99_s"] = round(q[0.99], 6)
+        return doc
+
+    def dump(self, trigger: str, path: Optional[str] = None) -> Dict[str, Any]:
+        """Snapshot the ring into one structured-JSON document. Written to
+        `path` when given, else to KTPU_FLIGHT_DIR (one file per dump) when
+        set; always retained as `last_dump` and counted per trigger.
+        Side-effect-free while disabled: KTPU_TELEMETRY=0 must not let an
+        unconditional call site (the takeover pass) clobber a prior
+        incident artifact with an empty-ring document."""
+        doc = self.snapshot_doc(trigger)
+        if not self.enabled:
+            return doc
+        self.last_dump = doc
+        self.dumps += 1
+        FLIGHT_DUMPS.inc(trigger=trigger)
+        if path is None:
+            flight_dir = os.environ.get("KTPU_FLIGHT_DIR")
+            if flight_dir:
+                path = os.path.join(
+                    flight_dir,
+                    f"flight-{self.name}-{trigger}-{doc['last_seq']}.json")
+        if path:
+            try:
+                with open(path, "w") as f:
+                    json.dump(doc, f, indent=1)
+                    f.write("\n")
+            except OSError:
+                pass  # a full disk must never take down the serving loop
+        return doc
+
+    # ------------------------------------------------------------------ #
+    # tier 3: device-time profiling (KTPU_PROFILE)
+    # ------------------------------------------------------------------ #
+
+    def device_annotation(self, name: str):
+        """Context for the primary dispatch: a jax.profiler TraceAnnotation
+        when KTPU_PROFILE is set (starting the profiler trace lazily on
+        first use), else a null context. Never raises."""
+        import contextlib
+
+        if not self.enabled or self.profile_dir is None:
+            return contextlib.nullcontext()
+        try:
+            import jax
+
+            if not self._profiling:
+                self._profiling = True
+                jax.profiler.start_trace(self.profile_dir)
+            return jax.profiler.TraceAnnotation(name)
+        except Exception:  # noqa: BLE001 - profiling must never break a wave
+            return contextlib.nullcontext()
+
+    def stop_profile(self) -> None:
+        if not self._profiling:
+            return
+        self._profiling = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 - shutdown must never raise
+            pass

@@ -163,3 +163,65 @@ class TestHPAOverMetricsAPI:
                         ["spec"]["replicas"] == 6, timeout=60)
         st = client.horizontalpodautoscalers.get("web").get("status", {})
         assert st.get("desiredReplicas") == 6
+
+
+class TestSchedulerExposition:
+    """ISSUE 7: the scheduler PROCESS serves its own scrape point — the
+    apiserver's /metrics covers the shared registry in-process, but a
+    production scheduler is a separate binary and needs its own
+    /metrics + /debug/flightrecorder (sched/server.py TelemetryGateway)."""
+
+    def test_metrics_and_flightrecorder_endpoints(self):
+        import json
+        import urllib.request
+
+        from kubernetes_tpu.apiserver import APIServer
+
+        api = APIServer()
+        client = Client.local(api)
+        client.nodes.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "n0"},
+            "status": {"capacity": {"cpu": "8", "memory": "16Gi",
+                                    "pods": "110"},
+                       "allocatable": {"cpu": "8", "memory": "16Gi",
+                                       "pods": "110"}}})
+        sched = SchedulerServer(client, telemetry_port=0).start()
+        try:
+            assert sched.telemetry_gateway is not None
+            url = sched.telemetry_gateway.url
+            client.pods.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "x", "namespace": "default"},
+                "spec": {"containers": [{
+                    "name": "c", "image": "i",
+                    "resources": {"requests": {"cpu": "100m",
+                                               "memory": "64Mi"}}}]}})
+            assert wait_for(
+                lambda: client.pods.get("x", "default")
+                .get("spec", {}).get("nodeName"), timeout=60)
+
+            def fetch(path):
+                with urllib.request.urlopen(url + path, timeout=10) as r:
+                    return r.status, r.read().decode()
+
+            code, text = fetch("/metrics")
+            assert code == 200
+            assert "scheduler_pod_e2e_latency_seconds_bucket" in text
+            assert "scheduler_scheduling_duration_seconds" in text
+            code, body = fetch("/debug/flightrecorder")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["trigger"] == "debug-endpoint"
+            assert doc["records"], "the served wave must be in the ring"
+            phases = [p for p, _ in doc["records"][-1]["phases"]]
+            assert "dispatch" in phases and "bind-commit" in phases
+            # the endpoint is READ-ONLY: a scrape loop must not clobber
+            # the incident artifact or count as a dump
+            tel = sched.scheduler.telemetry
+            assert tel.dumps == 0 and tel.last_dump is None
+            code, body = fetch("/healthz")
+            assert (code, body) == (200, "ok")
+        finally:
+            sched.stop()
+            api.close()

@@ -1,0 +1,480 @@
+"""Flight recorder + e2e latency telemetry (ISSUE 7; sched/telemetry.py,
+docs/OBSERVABILITY.md).
+
+Everything runs under deterministic clocks: the SCHEDULER clock (the
+queue/event time domain the e2e stamps live in) and the TELEMETRY clock
+(the phase-span domain) are injected separately, so phase ordering, ring
+eviction, first-seen-across-requeue and dump-on-abandon are all asserted
+exactly — no sleeps, no wall-time flakes.
+"""
+
+import json
+import logging
+import threading
+
+import pytest
+
+from kubernetes_tpu.api.types import Pod, Resources
+from kubernetes_tpu.component.metrics import Counter, Histogram, Registry
+from kubernetes_tpu.component.trace import Trace
+from kubernetes_tpu.models.workloads import make_nodes
+from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+from kubernetes_tpu.sched.telemetry import (
+    WAVE_PHASES,
+    FlightRecorder,
+    PodLatencyTracker,
+    SchedulerTelemetry,
+)
+from kubernetes_tpu.utils import faultline
+
+pytestmark = pytest.mark.latency
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faultline.uninstall()
+
+
+def _pod(i, **kw):
+    return Pod(name=f"p{i}",
+               requests=Resources.make(cpu="10m", memory="8Mi"),
+               creation_index=i, **kw)
+
+
+def _scheduler(clk, batch_size=64):
+    s = Scheduler(binder=RecordingBinder(), batch_size=batch_size,
+                  clock=lambda: clk["t"])
+    for n in make_nodes(8):
+        s.on_node_add(n)
+    return s
+
+
+# --------------------------------------------------------------------- #
+# satellite: component/trace.py threshold + exception semantics
+# --------------------------------------------------------------------- #
+
+class TestTraceFix:
+    def test_threshold_is_constructor_arg(self, caplog):
+        t = [0.0]
+        with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
+            with Trace("slow-but-allowed", clock=lambda: t[0],
+                       threshold=5.0):
+                t[0] = 1.0  # over the old hardcoded 0.1, under ours
+        assert not caplog.records
+        with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
+            with Trace("slow", clock=lambda: t[0], threshold=0.5) as tr:
+                tr.step("work")
+                t[0] = 2.0
+        assert any("slow" in r.message for r in caplog.records)
+
+    def test_exception_exit_skips_log_if_long(self, caplog):
+        t = [0.0]
+        with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
+            with pytest.raises(RuntimeError):
+                with Trace("doomed", clock=lambda: t[0], threshold=0.01):
+                    t[0] = 99.0  # way over threshold — but we raise
+                    raise RuntimeError("the failure path already reports")
+        assert not caplog.records
+
+
+# --------------------------------------------------------------------- #
+# tier 1: first-seen tracker
+# --------------------------------------------------------------------- #
+
+class TestPodLatencyTracker:
+    def test_first_seen_is_idempotent(self):
+        tr = PodLatencyTracker()
+        tr.stamp("a/x", 1.0)
+        tr.stamp("a/x", 5.0)   # a requeue must NOT move the stamp
+        assert tr.pop_latency("a/x", 11.0) == 10.0
+        assert tr.pop_latency("a/x", 12.0) is None  # consumed
+
+    def test_discard(self):
+        tr = PodLatencyTracker()
+        tr.stamp("a/x", 1.0)
+        tr.discard("a/x")
+        assert tr.pop_latency("a/x", 2.0) is None
+        assert len(tr) == 0
+
+
+# --------------------------------------------------------------------- #
+# tier 2: flight recorder ring
+# --------------------------------------------------------------------- #
+
+class TestFlightRecorder:
+    def test_ring_eviction(self):
+        fr = FlightRecorder(capacity=4)
+        for i in range(6):
+            fr.record({"marker": i})
+        recs = fr.records()
+        assert [r["marker"] for r in recs] == [2, 3, 4, 5]
+        assert [r["seq"] for r in recs] == [3, 4, 5, 6]
+        assert fr.evicted == 2
+        snap = fr.snapshot("manual")
+        assert snap["trigger"] == "manual"
+        assert snap["last_seq"] == 6
+        assert len(snap["records"]) == 4
+        json.dumps(snap)  # the dump document must be pure JSON
+
+
+# --------------------------------------------------------------------- #
+# wave spans through the real scheduler
+# --------------------------------------------------------------------- #
+
+class TestWaveSpans:
+    def test_phase_span_ordering_and_durations(self):
+        clk = {"t": 0.0}
+        s = _scheduler(clk)
+        # telemetry clock: +1ms per observation, so every phase gets a
+        # strictly positive, exactly-known duration
+        tick = {"n": 0}
+
+        def tel_clock():
+            tick["n"] += 1
+            return tick["n"] * 0.001
+
+        s.telemetry.clock = tel_clock
+        for i in range(5):
+            s.on_pod_add(_pod(i))
+        st = s.schedule_pending()
+        assert st.scheduled == 5
+        rec = s.telemetry.recorder.records()[-1]
+        names = [p for p, _ in rec["phases"]]
+        # the serving order, exactly (a healthy wave marks every phase)
+        assert names == ["pump", "pop", "snapshot", "prewarm", "dispatch",
+                         "readback", "intent-write", "bind-commit",
+                         "retire", "requeue"]
+        assert set(names) <= set(WAVE_PHASES)
+        assert all(dt > 0 for _, dt in rec["phases"])
+        assert rec["stats"]["scheduled"] == 5
+        assert rec["bucket"]["N"] >= 8
+        # tier 3 rode along on the primary dispatch
+        assert set(rec["device_split"]) == {"launch_s", "execute_s",
+                                            "readback_s"}
+
+    def test_e2e_histogram_and_per_phase_series_fed(self):
+        from kubernetes_tpu.sched.metrics import (POD_E2E_LATENCY,
+                                                  SCHEDULING_DURATION)
+
+        clk = {"t": 0.0}
+        s = _scheduler(clk)
+        before = POD_E2E_LATENCY.count()
+        phase_before = SCHEDULING_DURATION.count(operation="snapshot")
+        for i in range(3):
+            s.on_pod_add(_pod(i))
+        clk["t"] = 2.0
+        s.schedule_pending()
+        assert POD_E2E_LATENCY.count() == before + 3
+        assert SCHEDULING_DURATION.count(operation="snapshot") == \
+            phase_before + 1
+
+    def test_disabled_telemetry_is_a_noop(self):
+        clk = {"t": 0.0}
+        s = Scheduler(binder=RecordingBinder(), batch_size=64,
+                      clock=lambda: clk["t"])
+        s.telemetry = SchedulerTelemetry(enabled=False)
+        s.queue.tracker = None
+        for n in make_nodes(4):
+            s.on_node_add(n)
+        s.on_pod_add(_pod(0))
+        st = s.schedule_pending()
+        assert st.scheduled == 1
+        assert s.telemetry.recorder.records() == []
+        assert len(s.telemetry.latency_samples) == 0
+
+
+class TestFirstSeenAcrossRequeue:
+    def test_stamp_survives_unschedulable_backoff_round_trip(self):
+        """A pod that parks unschedulable, waits out a cluster event and
+        binds later must record ingest→bind, not last-requeue→bind."""
+        from kubernetes_tpu.api.types import Node
+
+        clk = {"t": 0.0}
+        s = _scheduler(clk)
+        # nodeSelector no node satisfies: the first wave verdicts the pod
+        # unschedulable and parks it
+        s.on_pod_add(_pod(0, node_selector={"pool": "later"}))
+        st = s.schedule_pending()
+        assert st.unschedulable == 1
+        assert len(s.telemetry.latency_samples) == 0
+        # the matching node arrives much later (move_all_to_active) and
+        # the pod finally binds
+        clk["t"] = 40.0
+        s.on_node_add(Node(name="late", labels={"pool": "later"},
+                           allocatable=Resources.make(cpu="8",
+                                                      memory="16Gi",
+                                                      pods=110)))
+        clk["t"] = 50.0
+        st = s.schedule_pending()
+        assert st.scheduled == 1
+        assert s.telemetry.latency_samples[-1] == pytest.approx(50.0)
+
+    def test_prompt_retry_keeps_stamp(self):
+        tr_clk = {"t": 3.0}
+        s = _scheduler(tr_clk)
+        p = _pod(0)
+        s.queue.add(p, now=3.0)
+        s.queue.pop_batch(10, now=4.0)
+        s.queue.add_prompt_retry(p, attempts=1, now=7.0)
+        assert s.telemetry.tracker.first_seen(p.key) == 3.0
+
+    def test_deleted_pending_pod_discards_stamp(self):
+        clk = {"t": 0.0}
+        s = _scheduler(clk)
+        p = _pod(0)
+        s.on_pod_add(p)
+        s.on_pod_delete(p)
+        assert s.telemetry.tracker.first_seen(p.key) is None
+
+
+# --------------------------------------------------------------------- #
+# dump-on-abandon: the acceptance drill — reconstruct the tick from the
+# artifact alone
+# --------------------------------------------------------------------- #
+
+@pytest.mark.chaos
+class TestDumpOnAbandon:
+    def test_abandoned_dispatch_dumps_a_reconstructable_record(self):
+        clk = {"t": 0.0}
+        s = _scheduler(clk)
+        for i in range(7):
+            s.on_pod_add(_pod(i))
+        faultline.install("device.error@cycle:1,device.fallback@cycle:1")
+        st = s.schedule_pending()
+        assert st.aborted == 7 and st.scheduled == 0
+        dump = s.telemetry.last_dump
+        assert dump is not None and dump["trigger"] == "abandoned"
+        doc = json.loads(json.dumps(dump))  # structured JSON end to end
+        rec = doc["records"][-1]
+        # the tick reconstructs WITHOUT logs: what ran (phase spans up to
+        # the readback that failed), what the supervisor did (degrade →
+        # abandon), and what happened to every popped pod (all requeued)
+        names = [p for p, _ in rec["phases"]]
+        assert names[:5] == ["pump", "pop", "snapshot", "prewarm",
+                             "dispatch"]
+        assert "readback" in names and "requeue" in names
+        assert "bind-commit" not in names  # nothing committed
+        kinds = [k for k, _ in rec["supervisor_events"]]
+        assert "degraded" in kinds and "abandoned" in kinds
+        assert rec["stats"]["attempted"] == 7
+        assert rec["stats"]["aborted"] == 7
+        assert rec["stats"]["scheduled"] == 0
+        from kubernetes_tpu.sched.metrics import FLIGHT_DUMPS
+
+        assert FLIGHT_DUMPS.value(trigger="abandoned") >= 1
+
+    def test_dump_to_file(self, tmp_path):
+        clk = {"t": 0.0}
+        s = _scheduler(clk)
+        s.on_pod_add(_pod(0))
+        s.schedule_pending()
+        path = tmp_path / "flight.json"
+        doc = s.telemetry.dump("manual", path=str(path))
+        on_disk = json.loads(path.read_text())
+        assert on_disk["trigger"] == "manual"
+        assert on_disk["last_seq"] == doc["last_seq"]
+        assert on_disk["records"]
+
+
+@pytest.mark.chaos
+@pytest.mark.fleet
+class TestFleetStormDump:
+    def test_storm_degraded_tick_dumps_with_tenant_attribution(self):
+        from kubernetes_tpu.fleet import FleetServer
+        from kubernetes_tpu.state.dims import Dims
+
+        clk = {"t": 0.0}
+        srv = FleetServer(batch_size=32, base_dims=Dims(N=8, P=32, E=64),
+                          clock=lambda: clk["t"])
+        srv.prewarmer.enabled = False
+        nodes = make_nodes(4)
+        for k in range(2):
+            t = srv.add_tenant(f"t{k:02d}")
+            for n in nodes:
+                t.on_node_add(n)
+            for i in range(6):
+                t.on_pod_add(Pod(name=f"t{k}-p{i}",
+                                 requests=Resources.make(cpu="10m",
+                                                         memory="8Mi"),
+                                 creation_index=i))
+        srv.tick()
+        clk["t"] += 1.0
+        faultline.install("tenant.storm@t00:1")
+        tk = srv.tick()
+        assert tk.per_tenant["t00"].degraded == 1
+        dump = srv.telemetry.last_dump
+        assert dump is not None and dump["trigger"] == "storm"
+        rec = dump["records"][-1]
+        assert rec["supervisor_events"] == [["storm", "t00"]] or \
+            rec["supervisor_events"] == [("storm", "t00")]
+        # per-tenant attribution on the record itself: ONLY t00 degraded
+        assert rec["fleet"]["t00"]["degraded"] == 1
+        assert rec["fleet"]["t01"]["degraded"] == 0
+
+
+class TestCrashedAndIdleWaves:
+    def test_exception_escaping_the_wave_still_records_and_dumps(self):
+        clk = {"t": 0.0}
+        s = _scheduler(clk)
+        s.on_pod_add(_pod(0))
+
+        def boom(pending):
+            raise ValueError("encode exploded")
+
+        s._snapshot_keys = boom
+        with pytest.raises(ValueError):
+            s.schedule_pending()
+        rec = s.telemetry.recorder.records()[-1]
+        assert rec["exception"] is True
+        names = [p for p, _ in rec["phases"]]
+        assert names[:2] == ["pump", "pop"] and names[-1] == "exception"
+        assert rec["stats"]["attempted"] == 1
+        assert s.telemetry.last_dump["trigger"] == "exception"
+
+    def test_idle_wave_drains_pending_supervisor_events(self):
+        clk = {"t": 0.0}
+        s = _scheduler(clk)
+        # e.g. a prewarm compile failure / prober recovery while idle
+        s.telemetry.note_supervisor_event("recovery", "prober re-admitted")
+        st = s.schedule_pending()     # empty queue
+        assert st.attempted == 0
+        rec = s.telemetry.recorder.records()[-1]
+        assert rec["engine"] == "idle"
+        assert ("recovery", "prober re-admitted") in rec["supervisor_events"]
+        # event-free idle waves record nothing — the ring stays signal
+        n = len(s.telemetry.recorder.records())
+        s.schedule_pending()
+        assert len(s.telemetry.recorder.records()) == n
+
+    def test_zombie_device_split_never_attaches_to_a_later_wave(self):
+        tel = SchedulerTelemetry(enabled=True)
+        span = tel.wave_span()
+        span.mark("pump")
+        # a long-abandoned wave's worker reports with ITS span as token
+        tel.note_device_split(60.0, 60.0, 0.1, token=object())
+        rec = tel.finish_wave(span, engine="waves")
+        assert "device_split" not in rec
+        # the live wave's own report (matching token) does attach
+        span2 = tel.wave_span()
+        span2.mark("pump")
+        tel.note_device_split(0.1, 0.2, 0.01, token=span2)
+        rec2 = tel.finish_wave(span2, engine="waves")
+        assert rec2["device_split"]["execute_s"] == 0.2
+
+
+# --------------------------------------------------------------------- #
+# fleet satellite: DRF clamp lands in the tenant-labelled metric through
+# CycleStats → observe_fleet_tick
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fleet
+class TestDrfClampedMetric:
+    def test_clamp_routes_through_cyclestats_to_metric(self):
+        from kubernetes_tpu.fleet import FleetServer
+        from kubernetes_tpu.sched.metrics import DRF_CLAMPED
+        from kubernetes_tpu.state.dims import Dims
+
+        clk = {"t": 0.0}
+        srv = FleetServer(batch_size=32, base_dims=Dims(N=8, P=32, E=64),
+                          clock=lambda: clk["t"])
+        srv.prewarmer.enabled = False
+        nodes = make_nodes(4)
+        # tenant 0 under a quota that funds roughly half its backlog (the
+        # dominant demand at this shape is the implicit pod slot)
+        n_pods = 8
+        tight = (n_pods / 2) * (1.0 / (len(nodes) * 110.0))
+        for k, quota in ((0, tight), (1, 1.0)):
+            t = srv.add_tenant(f"q{k:02d}", quota=quota)
+            for n in nodes:
+                t.on_node_add(n)
+            for i in range(n_pods):
+                t.on_pod_add(Pod(name=f"q{k}-p{i}",
+                                 requests=Resources.make(cpu="10m",
+                                                         memory="8Mi"),
+                                 creation_index=i))
+        before = DRF_CLAMPED.value(tenant="q00")
+        before_other = DRF_CLAMPED.value(tenant="q01")
+        tk = srv.tick()
+        assert tk.per_tenant["q00"].drf_clamped >= 1
+        assert tk.per_tenant["q01"].drf_clamped == 0
+        assert DRF_CLAMPED.value(tenant="q00") - before == \
+            tk.per_tenant["q00"].drf_clamped
+        assert DRF_CLAMPED.value(tenant="q01") == before_other
+        assert DRF_CLAMPED.total() >= DRF_CLAMPED.value(tenant="q00")
+
+
+# --------------------------------------------------------------------- #
+# satellite: metrics registry thread-safety hammer
+# --------------------------------------------------------------------- #
+
+class TestMetricsConcurrency:
+    def test_no_lost_increments_under_hammer(self):
+        reg = Registry()
+        c = reg.counter("hammer_total", labels=("who",))
+        h = reg.histogram("hammer_seconds")
+        g = reg.gauge("hammer_gauge")
+        n_threads, n_iter = 8, 2000
+        start = threading.Barrier(n_threads)
+
+        def worker(i):
+            start.wait()
+            for k in range(n_iter):
+                c.inc(who=f"w{i % 2}")
+                h.observe(0.01 * (k % 7))
+                g.inc()
+                g.dec(0.5)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value(who="w0") == n_threads // 2 * n_iter
+        assert c.value(who="w1") == n_threads // 2 * n_iter
+        assert c.total() == n_threads * n_iter
+        assert h.count() == n_threads * n_iter
+        assert g.value() == pytest.approx(n_threads * n_iter * 0.5)
+        # exposition is consistent under the same locks
+        text = reg.expose_text()
+        assert f"hammer_seconds_count {n_threads * n_iter}" in text
+
+    def test_registry_register_is_idempotent_under_races(self):
+        reg = Registry()
+        out = []
+        barrier = threading.Barrier(8)
+
+        def worker():
+            barrier.wait()
+            out.append(reg.counter("same_name"))
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(m is out[0] for m in out)
+
+
+# --------------------------------------------------------------------- #
+# device split + quantiles
+# --------------------------------------------------------------------- #
+
+class TestQuantilesAndSplit:
+    def test_latency_quantiles_exact(self):
+        tel = SchedulerTelemetry(enabled=True)
+        for v in (0.001, 0.002, 0.003, 0.004, 1.0):
+            tel.latency_samples.append(v)
+        q = tel.latency_quantiles((0.5, 0.99))
+        assert q[0.5] == 0.003
+        assert q[0.99] == 1.0
+
+    def test_histogram_quantile_buckets(self):
+        from kubernetes_tpu.component.metrics import Histogram
+
+        h = Histogram("q_test", "")
+        for v in (0.003, 0.003, 0.003, 0.9):
+            h.observe(v)
+        assert h.quantile(0.5) == 0.005   # bucket upper bound
+        assert h.quantile(0.99) == 1.0
