@@ -77,6 +77,12 @@ DEFAULT_STAGES = [
                                # (ROADMAP item 2), telemetry overhead
                                # bounded vs the untelemetered run, flight-
                                # recorder ring dumped to FLIGHT_OUT
+    (1000, 10000, "overload"),  # ISSUE 9: deterministic storm ramping
+                                # toward 10k ev/s + a mid-storm slow-bind
+                                # brownout drill — priority-aware shedding
+                                # (deferred, never dropped), commit
+                                # breaker opens and closes, recovery to
+                                # NORMAL <= 30 s, kill-switch bit-equality
     (5000, 50000, "classes"),  # run-collapsed admission vs the per-pod
                                # scan on a 200-class deployment backlog:
                                # bit-equal placements, ≥10× fewer scan steps
@@ -121,6 +127,9 @@ CYCLE_BUDGETS = {
                                  # METRIC_BUDGETS below; headroom for a
                                  # box-load stall mid-churn — observed
                                  # 0.5-10 s on the shared CPU box)
+    ("overload", 1000): 60.0,    # worst storm wave: the slow-bind drill
+                                 # stalls ~8 commits before the breaker
+                                 # opens mid-wave and cuts the rest
     ("classes", 5000): 60.0,     # the run-collapsed dispatch at 5k×50k
                                  # (the stage also times the per-pod scan
                                  # for the speedup check — budgeted via
@@ -198,6 +207,28 @@ METRIC_BUDGETS = {
                         "telemetry_overhead_pct": ("<=", 2.0),
                         "e2e_recorded": (">=", 1),
                         "lost_pods": ("<=", 0)},
+    # ISSUE 9 acceptance: the storm loses nothing and double-binds
+    # nothing; high-priority p99 stays bounded WHILE the storm (and the
+    # mid-storm slow-bind brownout) runs; low-priority pods are provably
+    # deferred-then-admitted; the breaker opens AND closes again; the
+    # governor is back to NORMAL <= 30 s after the storm stops; and with
+    # KTPU_OVERLOAD=0 placements are bit-equal to the governor-on healthy
+    # run (the kill-switch / NORMAL-is-a-no-op contract). The hi_p99
+    # bound is generous for loaded CI boxes — the *ordering* claim (high
+    # flows while low defers) is what the deferred metrics pin down.
+    ("overload", 1000): {"lost_pods": ("<=", 0),
+                         "double_bound": ("<=", 0),
+                         "hi_p99_ms": ("<=", 15000.0),
+                         # the p99 bound must never pass vacuously: high-
+                         # priority pods DID bind while the storm ran
+                         "hi_bound_in_storm": (">=", 1),
+                         "deferred_then_admitted": (">=", 1),
+                         "shed_total": (">=", 1),
+                         "breaker_opens": (">=", 1),
+                         "breaker_closes": (">=", 1),
+                         "mode_transitions": (">=", 2),
+                         "recovery_to_normal_s": ("<=", 30.0),
+                         "kill_switch_bit_equal": (">=", 1)},
     ("mesh", 5000): {"bit_equal": (">=", 1),
                      "resident_full_uploads": ("<=", 1),
                      "donated_patches": (">=", 1),
@@ -270,15 +301,26 @@ def _run_stage(n_nodes, n_pods, kind, env, timeout):
     """Run one shape in a subprocess; returns a result dict (never raises)."""
     global _CURRENT_PROC
     env = dict(env)
-    if kind not in ("chaos", "failover"):
+    if kind not in ("chaos", "failover", "overload"):
         # FAULT_SPEC is the fault-drill stages' contract alone: an operator
         # running the documented drill (FAULT_SPEC=... python bench.py)
-        # must not have faults injected into the other stages' budgets
+        # must not have faults injected into the other stages' budgets.
+        # The overload stage joins the drill club: its default
+        # apiserver.slow@bind brownout can be swapped for store.latency@/
+        # watch.storm@ specs from the driver env.
         env.pop("FAULT_SPEC", None)
     # every stage decides its own mesh explicitly (Scheduler(mesh=...));
     # an ambient KTPU_MESH would silently mesh-back the single-device
     # baselines — including the mesh stage's own bit-equality reference
     env.pop("KTPU_MESH", None)
+    if kind != "overload":
+        # same isolation discipline for the overload governor: every
+        # other stage measures ITS subsystem's budgets, and an adaptive
+        # governor reacting to a loaded CI box mid-measurement (shedding
+        # a bit-equality stage's pods, shrinking a perf stage's waves)
+        # would be nondeterminism, not signal. The overload stage owns
+        # the governor — and proves kill-switch bit-equality itself.
+        env["KTPU_OVERLOAD"] = "0"
     if kind in ("mesh", "multichip", "fleet") \
             and os.environ.get("KTPU_MESH_STAGE_REAL") != "1":
         # the multichip stages run on an 8-way VIRTUAL CPU mesh (ISSUE 3:
@@ -1536,6 +1578,280 @@ def _latency_stage(n_nodes, n_pods):
     }))
 
 
+def _overload_stage(n_nodes, n_pods):
+    """ISSUE 9 acceptance stage: a deterministic STORM generator ramps pod
+    creation toward 10k events/s against the resident scheduler, with a
+    priority mix (20% high / 80% low), the real APIBinder→LocalTransport→
+    apiserver commit path, and a mid-storm brownout drill: the
+    `apiserver.slow@bind` seam stalls every Binding write until the commit
+    breaker (sched/overload.py) opens; clearing the fault lets the
+    half-open probes close it again. What the budgets prove:
+
+      * zero lost pods and zero double binds across the full storm;
+      * high-priority watch→bind p99 stays bounded WHILE the storm runs
+        (shed/trickle waves pop highest-priority first — brownout favors
+        exactly the pods that must keep flowing);
+      * low-priority pods are provably deferred-then-admitted: every pod
+        observed parked in the deferred lane is bound by the end
+        (`deferred_then_admitted`), never dropped;
+      * the breaker opens >= 1 and closes again; the governor returns to
+        NORMAL within 30 s of the storm stopping;
+      * with KTPU_OVERLOAD=0 (the kill switch) placements are bit-equal
+        to the governor-enabled healthy run — in NORMAL the governor
+        provably changes nothing (`kill_switch_bit_equal`).
+
+    FAULT_SPEC passes through from the driver (like chaos/failover), so an
+    operator can swap the drill for `store.latency@...`/`watch.storm@...`."""
+    import jax
+
+    from kubernetes_tpu.api.types import Pod, Resources
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.rest import Client, RetryPolicy
+    from kubernetes_tpu.models.workloads import make_nodes
+    from kubernetes_tpu.sched.overload import (
+        NORMAL, OverloadConfig, OverloadGovernor)
+    from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+    from kubernetes_tpu.sched.server import APIBinder
+    from kubernetes_tpu.state.dims import Dims, bucket
+    from kubernetes_tpu.utils import faultline
+
+    batch = min(512, max(64, n_pods // 16))
+    base = Dims(N=bucket(n_nodes), P=bucket(batch),
+                E=bucket(2 * batch + 256))
+    nodes = make_nodes(n_nodes)
+    hi_prio, lo_prio, cutoff = 100, 0, 50
+
+    # ---- kill-switch bit-equality (small healthy run, both settings) --- #
+    def _mini_run(overload_on):
+        prev = os.environ.get("KTPU_OVERLOAD")
+        os.environ["KTPU_OVERLOAD"] = "1" if overload_on else "0"
+        try:
+            s = Scheduler(binder=RecordingBinder(), batch_size=256,
+                          base_dims=base)
+            s.prewarmer.enabled = False
+            for n in nodes[:200]:
+                s.on_node_add(n)
+            for i in range(1000):
+                s.on_pod_add(Pod(
+                    name=f"eq-{i}",
+                    priority=hi_prio if i % 5 == 0 else lo_prio,
+                    requests=Resources.make(cpu="20m", memory="16Mi"),
+                    creation_index=i))
+            return dict(s.run_until_idle().assignments)
+        finally:
+            if prev is None:
+                os.environ.pop("KTPU_OVERLOAD", None)
+            else:
+                os.environ["KTPU_OVERLOAD"] = prev
+
+    eq_on = _mini_run(True)
+    eq_off = _mini_run(False)
+    kill_switch_bit_equal = int(eq_on == eq_off and len(eq_on) > 0)
+
+    # ---- the storm rig: real apiserver commit path ---- #
+    api = APIServer()
+    client = Client.local(api, retry=RetryPolicy(attempts=2,
+                                                 deadline_s=2.0))
+    bind_record = {}
+
+    class _TrackingBinder(APIBinder):
+        def bind(self, pod, node_name):
+            ok = super().bind(pod, node_name)
+            if ok:
+                bind_record.setdefault(pod.key, []).append(
+                    (node_name, time.monotonic()))
+            return ok
+
+    binder = _TrackingBinder(client, bind_deadline_s=1.0)
+    s = Scheduler(binder=binder, batch_size=batch, base_dims=base)
+    s.prewarmer.enabled = False
+    # storm-tuned governor: thresholds the ramp provably crosses on any
+    # box (production defaults are deliberately far more conservative)
+    cfg = OverloadConfig(
+        shed_enter_pressure=1.5, shed_exit_pressure=0.75,
+        trickle_enter_pressure=8.0, trickle_exit_pressure=3.0,
+        exit_dwell_s=1.0, shed_priority_cutoff=cutoff,
+        target_cycle_s=0.05, min_wave=64, trickle_wave=64, slow_streak=2,
+        fail_threshold=5, latency_slo_s=0.08, latency_min_samples=8,
+        cooldown_s=1.0, cooldown_cap_s=8.0, probe_successes=2)
+    gov = OverloadGovernor(batch, cfg=cfg, clock=s.clock,
+                           event_sink=s.telemetry.note_supervisor_event,
+                           name="overload-bench")
+    s.governor = gov
+    for n in nodes:
+        s.on_node_add(n)
+
+    os.environ.setdefault("KTPU_SLOW_S", "0.12")
+    drill_spec = os.environ.get("FAULT_SPEC") or "apiserver.slow@bind:1+"
+
+    def _mkpod(i):
+        prio = hi_prio if i % 5 == 0 else lo_prio
+        name = f"storm-{i}"
+        obj = client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "img",
+                "resources": {"requests": {
+                    "cpu": "20m", "memory": "16Mi"}}}]}})
+        # the bind's uid precondition must match the SERVER's pod, not a
+        # synthesized one (Pod.__post_init__ defaults uid to ns/name)
+        return Pod(name=name, priority=prio,
+                   uid=obj["metadata"]["uid"],
+                   requests=Resources.make(cpu="20m", memory="16Mi"),
+                   creation_index=i)
+
+    # pre-create the storm pods: the apiserver-side POSTs are setup, not
+    # the signal — the storm under test is the SCHEDULER-side ingest
+    # (on_pod_add at up to 10k ev/s), which pre-creation keeps honest
+    storm_pods = [_mkpod(i) for i in range(n_pods)]
+
+    # warmup: compile the wave program outside every measured window
+    for i in range(128):
+        obj = client.pods.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"warm-{i}", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "img",
+                "resources": {"requests": {
+                    "cpu": "20m", "memory": "16Mi"}}}]}})
+        s.on_pod_add(Pod(name=f"warm-{i}", priority=hi_prio,
+                         uid=obj["metadata"]["uid"],
+                         requests=Resources.make(cpu="20m", memory="16Mi"),
+                         creation_index=i))
+    for _ in range(32):
+        st = s.schedule_pending()
+        _churn(s, st)
+        if s.queue.lengths()[0] == 0:
+            break
+
+    # ---- the storm: ramp toward 10k ev/s, drill mid-storm ---- #
+    t_add = {}
+    rate_cap = float(os.environ.get("KTPU_STORM_EVENTS_PER_S", "10000"))
+    # ramp chosen so the integral over the ramp (~9.9k events at 1.8 s)
+    # is just under n_pods at the default shape: the tail of the storm
+    # injects AT the 10k ev/s cap, not merely toward it
+    ramp_s = 1.8
+    injected = 0
+    waves = []
+    deferred_seen = set()
+    deferred_peak = 0
+    fault_installed = False
+    t0 = time.monotonic()
+    t_storm_end = None
+    t_inject_done = None
+    while True:
+        el = time.monotonic() - t0
+        rate = min(rate_cap, 1000.0 + (rate_cap - 1000.0) * el / ramp_s)
+        due = min(n_pods, int(1000.0 * el + (rate - 1000.0) * el / 2)) \
+            if el < ramp_s else n_pods
+        while injected < due:
+            p = storm_pods[injected]
+            t_add[p.key] = time.monotonic()
+            s.on_pod_add(p)
+            injected += 1
+        if injected >= n_pods and t_inject_done is None:
+            t_inject_done = time.monotonic()
+        if not fault_installed and injected >= int(0.3 * n_pods):
+            faultline.install(drill_spec)
+            fault_installed = True
+        c0 = time.perf_counter()
+        st = s.schedule_pending()
+        if st.attempted:
+            waves.append(time.perf_counter() - c0)
+        _churn(s, st)
+        dk = s.queue.deferred_keys()
+        deferred_seen.update(dk)
+        deferred_peak = max(deferred_peak, len(dk))
+        if injected >= n_pods and fault_installed \
+                and (gov.breaker.opens >= 1
+                     or time.monotonic() - t0 > 90):
+            faultline.uninstall()
+            t_storm_end = time.monotonic()
+            break
+        if time.monotonic() - t0 > 150:
+            faultline.uninstall()
+            t_storm_end = time.monotonic()
+            break
+    storm_s = t_storm_end - t0
+    hi_storm = [bt - t_add[k] for k, v in bind_record.items()
+                for _n, bt in v[:1]
+                if k.startswith("default/storm-")
+                and int(k.rsplit("-", 1)[1]) % 5 == 0
+                and bt <= t_storm_end]
+
+    # ---- recovery: breaker closes, governor returns to NORMAL ---- #
+    t_normal = None
+    while time.monotonic() - t_storm_end < 45.0:
+        st = s.schedule_pending()
+        _churn(s, st)
+        if gov.mode == NORMAL and gov.breaker.state == "closed":
+            t_normal = time.monotonic()
+            break
+        if st.attempted == 0:
+            time.sleep(0.01)
+    recovery_s = (t_normal - t_storm_end) if t_normal else 1e9
+
+    # ---- drain: every deferred pod must come back and bind ---- #
+    t_drain = time.monotonic()
+    while time.monotonic() - t_drain < 180.0:
+        st = s.schedule_pending()
+        _churn(s, st)
+        d = s.queue.depths()
+        if sum(d.values()) == 0:
+            break
+        if st.attempted == 0:
+            time.sleep(0.01)
+
+    bound = {k for k in bind_record if k.startswith("default/storm-")}
+    lost = n_pods - len(bound) - sum(s.queue.depths().values())
+    double = sum(1 for v in bind_record.values() if len(v) > 1)
+    admitted_after_defer = len(deferred_seen & bound)
+    lo_lat = [v[0][1] - t_add[k] for k, v in bind_record.items()
+              if k in t_add and k.startswith("default/storm-")
+              and int(k.rsplit("-", 1)[1]) % 5 != 0]
+
+    def _p99(xs):
+        return sorted(xs)[min(int(0.99 * len(xs)), len(xs) - 1)] if xs \
+            else 0.0
+
+    print(json.dumps({
+        "nodes": n_nodes, "pods": n_pods, "kind": "overload",
+        "scheduled": len(bound), "failed": max(lost, 0),
+        "events_per_sec_target": rate_cap,
+        "events_per_sec_achieved": round(
+            n_pods / max((t_inject_done or t_storm_end) - t0, 1e-9), 1),
+        "storm_seconds": round(storm_s, 2),
+        "hi_p99_ms": round(_p99(hi_storm) * 1000.0, 1),
+        "hi_bound_in_storm": len(hi_storm),
+        "shed_p99_ms": round(_p99(lo_lat) * 1000.0, 1),
+        "deferred_peak": deferred_peak,
+        "deferred_then_admitted": admitted_after_defer,
+        "shed_total": gov.shed_total,
+        "mode_transitions": gov.mode_transitions,
+        "breaker_opens": gov.breaker.opens,
+        "breaker_closes": gov.breaker.closes,
+        "paused_waves": gov.paused_waves,
+        "recovery_to_normal_s": round(recovery_s, 2),
+        "pushback_retries": binder.pushback_retries,
+        "lost_pods": max(lost, 0),
+        "double_bound": double,
+        "kill_switch_bit_equal": kill_switch_bit_equal,
+        "cycle_seconds": round(max(waves), 3) if waves else 0.0,
+        "pods_per_sec": round(len(bound) / max(storm_s, 1e-9), 1),
+        "backend": jax.default_backend(),
+    }))
+
+
+def _churn(s, stats):
+    """Completed-pod churn for the resident-scheduler stages: a bound pod
+    completes and leaves, keeping the cache (and the E bucket) bounded."""
+    import dataclasses
+
+    for key, node_name in stats.assignments.items():
+        pod = s.cache.get_pod(key)
+        if pod is not None:
+            s.on_pod_delete(dataclasses.replace(pod, node_name=node_name))
+
+
 def _probe_stage():
     """Backend probe (phase 1): ONE minimal end-to-end dispatch at the Dims
     floor — backend init + tiny compile + readback, nothing else. The old
@@ -1696,6 +2012,9 @@ def _stage_main(n_nodes, n_pods, kind):
     if kind == "latency":
         _latency_stage(n_nodes, n_pods)
         return
+    if kind == "overload":
+        _overload_stage(n_nodes, n_pods)
+        return
     if kind == "probe":
         _probe_stage()
         return
@@ -1855,6 +2174,10 @@ def _compact_line(full, out_name, wrote):
             if r.get("kind") == "latency":
                 e["p50_ms"] = r.get("p50_ms")
                 e["p99_ms"] = r.get("p99_ms")
+            if r.get("kind") == "overload":
+                e["mode_transitions"] = r.get("mode_transitions")
+                e["breaker_opens"] = r.get("breaker_opens")
+                e["shed_p99_ms"] = r.get("shed_p99_ms")
             if r.get("kind") == "multichip":
                 e["out"] = r.get("out")
             if r.get("within_budget") is False:

@@ -17,8 +17,10 @@ Request paths match the reference wire layout:
 from __future__ import annotations
 
 import json
+import os
 import socketserver
 import threading
+import time
 from http.server import BaseHTTPRequestHandler
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -38,6 +40,70 @@ VERSION_INFO = {
     "platform": "jax/xla-tpu",
 }
 
+# registered HERE, against the shared registry, like client/informers.py
+# does for its own series — an apiserver metric must not depend on the
+# sched package being importable
+from kubernetes_tpu.component.metrics import DEFAULT_REGISTRY as _REG  # noqa: E402
+
+APISERVER_INFLIGHT_REJECTS = _REG.counter(
+    "apiserver_inflight_request_rejects_total",
+    "Requests rejected 429 by the max-inflight filter, by class",
+    labels=("kind",))
+
+
+class MaxInflightFilter:
+    """Admission-by-capacity for the request path (ISSUE 9) — the analog
+    of the reference's max-inflight filter
+    (apiserver/pkg/server/filters/maxinflight.go): at most `limit`
+    readonly and `mutating_limit` mutating requests execute concurrently;
+    a request arriving with the lane full is rejected IMMEDIATELY with
+    429 TooManyRequests + `retryAfterSeconds` (the reference's
+    `Retry-After: 1`) — never queued, so a storm cannot pile latency onto
+    requests the server will shed anyway. Watches are exempt (the
+    long-running-request check): they hold their slot for the stream's
+    lifetime and are bounded by the watcher registry instead.
+
+    0 (the default) disables a lane. Thread-safe: the HTTP gateway serves
+    from a thread pool and LocalTransport callers race informer pumps."""
+
+    def __init__(self, limit: int = 0, mutating_limit: int = 0,
+                 retry_after_s: int = 1):
+        self.limit = int(limit)
+        self.mutating_limit = int(mutating_limit)
+        self.retry_after_s = retry_after_s
+        self._mu = threading.Lock()
+        self._inflight = 0
+        self._inflight_mutating = 0
+        self.rejected = 0
+        self.rejected_mutating = 0
+        self.peak = 0
+
+    def acquire(self, mutating: bool) -> bool:
+        with self._mu:
+            if mutating:
+                if self.mutating_limit and \
+                        self._inflight_mutating >= self.mutating_limit:
+                    self.rejected_mutating += 1
+                    APISERVER_INFLIGHT_REJECTS.inc(kind="mutating")
+                    return False
+                self._inflight_mutating += 1
+            else:
+                if self.limit and self._inflight >= self.limit:
+                    self.rejected += 1
+                    APISERVER_INFLIGHT_REJECTS.inc(kind="readonly")
+                    return False
+                self._inflight += 1
+            self.peak = max(self.peak,
+                            self._inflight + self._inflight_mutating)
+            return True
+
+    def release(self, mutating: bool) -> None:
+        with self._mu:
+            if mutating:
+                self._inflight_mutating -= 1
+            else:
+                self._inflight -= 1
+
 
 class APIServer:
     """The in-process REST engine: one Store per served resource.
@@ -49,10 +115,23 @@ class APIServer:
 
     def __init__(self, storage: Optional[Storage] = None,
                  admission: Optional[AdmissionFn] = None,
-                 scheme: Optional[Scheme] = None):
+                 scheme: Optional[Scheme] = None,
+                 max_inflight: Optional[int] = None,
+                 max_mutating_inflight: Optional[int] = None):
         from kubernetes_tpu.apiserver.admission import AdmissionChain
         from kubernetes_tpu.apiserver.crd import install_crd_hook
 
+        # max-inflight request gate (maxinflight.go analog): explicit
+        # ctor limits win; env KTPU_MAX_INFLIGHT / KTPU_MAX_MUTATING_
+        # INFLIGHT otherwise; unset/0 = unlimited (the historical shape)
+        if max_inflight is None:
+            max_inflight = int(os.environ.get("KTPU_MAX_INFLIGHT", "0") or 0)
+        if max_mutating_inflight is None:
+            max_mutating_inflight = int(os.environ.get(
+                "KTPU_MAX_MUTATING_INFLIGHT", "0") or 0)
+        self.inflight = MaxInflightFilter(
+            max_inflight, max_mutating_inflight) \
+            if (max_inflight or max_mutating_inflight) else None
         self.storage = storage or Storage()
         self.scheme = scheme or build_scheme()
         if admission is None:
@@ -159,6 +238,13 @@ class APIServer:
         leader's write racing its own failover and is rejected with 409 —
         the server-side half of exactly-once binding across leader
         handoffs. Unstamped Bindings (non-HA schedulers, kubectl) pass."""
+        from kubernetes_tpu.utils import faultline
+
+        if faultline.should("apiserver.slow", "bind"):
+            # chaos: the commit path specifically outruns capacity — the
+            # bind stalls KTPU_SLOW_S while the rest of the API stays
+            # fast (what trips the commit-latency SLO, not the ingest)
+            time.sleep(float(os.environ.get("KTPU_SLOW_S", "0.2")))
         target = (binding.get("target") or {}).get("name", "")
         if not target:
             raise errors.new_bad_request("binding.target.name is required")
@@ -438,13 +524,42 @@ def _conversion_for(api: APIServer, path: str):
 def handle_rest(api: APIServer, method: str, path: str,
                 query: Dict[str, str], body: Optional[Obj], user: str = ""):
     """Route one REST request. Returns (code, obj) or ("WATCH", Watch).
-    Multi-version CRD requests convert at this chokepoint: bodies from the
+
+    The max-inflight gate (ISSUE 9) sits here — the chokepoint BOTH the
+    HTTP gateway and LocalTransport cross — so in-proc storms are shed
+    exactly like wire storms. Watches are exempt (long-running); a full
+    lane rejects with 429 + retryAfterSeconds before any routing work."""
+    gate = api.inflight
+    if gate is None or query.get("watch", "") in ("true", "1"):
+        return _handle_rest_admitted(api, method, path, query, body, user)
+    mutating = method not in ("GET", "HEAD")
+    if not gate.acquire(mutating):
+        raise errors.new_too_many_requests(
+            "too many requests in flight, please retry",
+            retry_seconds=gate.retry_after_s)
+    try:
+        return _handle_rest_admitted(api, method, path, query, body, user)
+    finally:
+        gate.release(mutating)
+
+
+def _handle_rest_admitted(api: APIServer, method: str, path: str,
+                          query: Dict[str, str], body: Optional[Obj],
+                          user: str = ""):
+    """The pre-gate handle_rest: CRD conversion chokepoint + audit +
+    router. Multi-version CRD requests convert here: bodies from the
     requested version to the storage version, results back (lists per item,
     watches per event). Mutations are audited here too (stage
     ResponseComplete, both outcomes) — the reference's audit filter sits in
     the same position in the handler chain."""
     from kubernetes_tpu.utils import faultline
 
+    if faultline.should("apiserver.slow", "handle_rest"):
+        # chaos: a control plane drowning in its own queue — every hit
+        # request stalls for KTPU_SLOW_S before routing (the overload
+        # drills use this to breach the commit-latency SLO
+        # deterministically; the breaker is what's under test)
+        time.sleep(float(os.environ.get("KTPU_SLOW_S", "0.2")))
     if faultline.should("apiserver.restart", "handle_rest"):
         # chaos: the apiserver process dies and comes back between two
         # requests. Storage (etcd) survives; every open watch connection
@@ -821,6 +936,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        if code == 429 and isinstance(obj, dict):
+            # the reference's max-inflight filter sets Retry-After: 1;
+            # the Status body carries the same value as retryAfterSeconds
+            ra = (obj.get("details") or {}).get("retryAfterSeconds")
+            self.send_header("Retry-After", str(int(ra or 1)))
         self.end_headers()
         self.wfile.write(data)
 

@@ -320,6 +320,13 @@ class SharedInformer:
                         break
                     if faultline.should("watch.relist", "informer"):
                         return  # chaos: 410-equivalent → full relist
+                    if faultline.should("watch.storm", "informer"):
+                        # chaos: an event storm — the whole world redelivers
+                        # at once (a relist IS a storm: every object arrives
+                        # as one burst of upserts). The overload governor's
+                        # ingest-pressure signal is what this exercises; the
+                        # at-least-once contract makes the redelivery safe.
+                        return
                     self._dispatch(ev)
                     self.last_sync_rv = meta.resource_version(ev.object) or \
                         self.last_sync_rv

@@ -9,10 +9,13 @@ with chunked watch streams. Components depend only on `Client`.
 from __future__ import annotations
 
 import json
+import random
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
+from dataclasses import dataclass
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 from kubernetes_tpu.machinery import errors, meta
@@ -21,19 +24,63 @@ from kubernetes_tpu.machinery import watch as mwatch
 Obj = Dict[str, Any]
 
 
+@dataclass
+class RetryPolicy:
+    """Client-side retry budget for server PUSHBACK (ISSUE 9): 429 from
+    the apiserver's max-inflight filter and 503 from a restart window are
+    rejected BEFORE the request mutates anything, so retrying them is
+    safe for every verb. Capped exponential backoff with jitter; the
+    Status' `retryAfterSeconds` (the wire form of the reference's
+    `Retry-After: 1` header) is honored as a floor; `deadline_s` bounds
+    the whole attempt train. Any other failure propagates immediately."""
+
+    attempts: int = 3          # retries after the first try
+    base_s: float = 0.05
+    cap_s: float = 1.0
+    deadline_s: float = 5.0
+    # observability hook: called once per retry actually taken (APIBinder
+    # counts absorbed pushback through it)
+    on_retry: Optional[Any] = None
+
+    def run(self, fn):
+        deadline = time.monotonic() + self.deadline_s
+        delay = self.base_s
+        for attempt in range(self.attempts + 1):
+            try:
+                return fn()
+            except errors.StatusError as e:
+                if e.code not in (429, 503) or attempt >= self.attempts:
+                    raise
+                ra = float((e.details or {}).get("retryAfterSeconds") or 0)
+                wait = max(ra, delay * random.uniform(0.5, 1.0))
+                if time.monotonic() + wait > deadline:
+                    raise
+                if self.on_retry is not None:
+                    self.on_retry()
+                time.sleep(wait)
+                delay = min(delay * 2, self.cap_s)
+        raise AssertionError("unreachable")  # loop always returns/raises
+
+
 class LocalTransport:
     """Direct calls into an in-process APIServer (no serialization cost —
-    the reference's integration suite does the same with its in-proc master)."""
+    the reference's integration suite does the same with its in-proc
+    master). `retry` opts into the pushback budget — the in-proc
+    max-inflight filter raises the same 429s the wire path serves."""
 
-    def __init__(self, api):
+    def __init__(self, api, retry: Optional[RetryPolicy] = None):
         self.api = api
+        self.retry = retry
 
     def request(self, method: str, path: str, query: Dict[str, str],
                 body: Optional[Obj]) -> Obj:
         from kubernetes_tpu.apiserver.server import handle_rest
 
-        code, obj = handle_rest(self.api, method, path, dict(query), body)
-        return obj
+        def once() -> Obj:
+            code, obj = handle_rest(self.api, method, path, dict(query), body)
+            return obj
+
+        return once() if self.retry is None else self.retry.run(once)
 
     def stream_watch(self, path: str, query: Dict[str, str]) -> mwatch.Watch:
         from kubernetes_tpu.apiserver.server import handle_rest
@@ -52,11 +99,13 @@ class HTTPTransport:
     client takes, protobuf.go); JSON stays the default and the fallback."""
 
     def __init__(self, base_url: str, timeout: float = 30.0,
-                 token: str = "", binary: bool = False):
+                 token: str = "", binary: bool = False,
+                 retry: Optional[RetryPolicy] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
         self.binary = binary
+        self.retry = retry
 
     def _url(self, path: str, query: Dict[str, str]) -> str:
         url = self.base_url + path
@@ -76,6 +125,13 @@ class HTTPTransport:
 
     def request(self, method: str, path: str, query: Dict[str, str],
                 body: Optional[Obj]) -> Obj:
+        if self.retry is None:
+            return self._request_once(method, path, query, body)
+        return self.retry.run(
+            lambda: self._request_once(method, path, query, body))
+
+    def _request_once(self, method: str, path: str, query: Dict[str, str],
+                      body: Optional[Obj]) -> Obj:
         from kubernetes_tpu.machinery import codec
 
         # the patch dialect travels as a Content-Type on the wire (the
@@ -121,6 +177,15 @@ class HTTPTransport:
         q = dict(query)
         q["watch"] = "true"
         q.setdefault("timeoutSeconds", "3600")
+        # the socket timeout derives from the timeoutSeconds ACTUALLY sent
+        # (plus the request-timeout margin) — a short-timeout watch must
+        # hang up when the server does, not 1 h later (the old hardcoded
+        # `self.timeout + 3600` kept a 10 s watch's socket open 3610 s)
+        try:
+            server_timeout = float(q["timeoutSeconds"])
+        except (TypeError, ValueError):
+            server_timeout = 3600.0
+        sock_timeout = self.timeout + server_timeout
         w = mwatch.Watch(capacity=8192)
 
         def pump_json(r) -> None:
@@ -155,7 +220,7 @@ class HTTPTransport:
                     req.add_header("Authorization", f"Bearer {self.token}")
                 if self.binary:
                     req.add_header("Accept", codec.BINARY_MEDIA_TYPE)
-                with urllib.request.urlopen(req, timeout=self.timeout + 3600) as r:
+                with urllib.request.urlopen(req, timeout=sock_timeout) as r:
                     ctype = (r.headers.get("Content-Type") or "").split(";")[0]
                     if ctype == codec.BINARY_MEDIA_TYPE:
                         pump_binary(r)
@@ -261,7 +326,8 @@ class ResourceClient:
 
     def watch(self, namespace: str = "", label_selector: str = "",
               field_selector: str = "", resource_version: str = "",
-              allow_bookmarks: bool = False) -> mwatch.Watch:
+              allow_bookmarks: bool = False,
+              timeout_seconds: Optional[int] = None) -> mwatch.Watch:
         q: Dict[str, str] = {}
         if label_selector:
             q["labelSelector"] = label_selector
@@ -271,6 +337,10 @@ class ResourceClient:
             q["resourceVersion"] = resource_version
         if allow_bookmarks:
             q["allowWatchBookmarks"] = "true"
+        if timeout_seconds is not None:
+            # rides to the server AND (HTTP transport) sizes the socket
+            # timeout — the two can no longer disagree by an hour
+            q["timeoutSeconds"] = str(int(timeout_seconds))
         return self.transport.stream_watch(self._path(namespace), q)
 
     # -- subresources ------------------------------------------------------- #
@@ -363,14 +433,17 @@ class Client:
         self._cache: Dict[Tuple[str, str, str], ResourceClient] = {}
 
     @staticmethod
-    def local(api) -> "Client":
-        return Client(LocalTransport(api))
+    def local(api, retry: Optional[RetryPolicy] = None) -> "Client":
+        return Client(LocalTransport(api, retry=retry))
 
     @staticmethod
-    def http(base_url: str, token: str = "", binary: bool = False) -> "Client":
+    def http(base_url: str, token: str = "", binary: bool = False,
+             retry: Optional[RetryPolicy] = None) -> "Client":
         """`binary=True` negotiates the binary codec for every request and
-        watch stream — the internal-client configuration (protobuf.go)."""
-        return Client(HTTPTransport(base_url, token=token, binary=binary))
+        watch stream — the internal-client configuration (protobuf.go).
+        `retry` opts into the 429/503 pushback budget (RetryPolicy)."""
+        return Client(HTTPTransport(base_url, token=token, binary=binary,
+                                    retry=retry))
 
     def resource(self, group: str, version: str, resource: str,
                  namespaced: bool = True) -> ResourceClient:

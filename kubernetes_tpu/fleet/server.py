@@ -82,6 +82,12 @@ class FleetTenant:
         # the fleet's prewarmer owns compile-ahead; the per-tenant one
         # would warm single-cluster programs nobody dispatches
         self.sched.prewarmer.enabled = False
+        if self.sched.governor is not None:
+            # per-tenant governor series label by TENANT, not the shared
+            # scheduler name — every tenant writes the same registry, and
+            # tenant B's NORMAL must not overwrite A's live brownout
+            self.sched.governor.name = name
+            self.sched.governor.breaker.name = name
         self.storm_ticks = 0
 
     # -- event-ingest passthrough (the informer routing surface) -- #
@@ -267,10 +273,11 @@ class FleetServer:
             tick.per_tenant[t.name] = CycleStats()
         span = self.telemetry.wave_span("fleet-tick")
 
-        # ---- pump + storm seam + pop ---- #
+        # ---- pump + storm seam + governed pop ---- #
         batches: Dict[str, List] = {}
         for t in tlist:
             s = t.sched
+            st = tick.per_tenant[t.name]
             s.queue.pump(now)
             s.cache.cleanup(now)
             if faultline.should("tenant.storm", t.name):
@@ -281,13 +288,50 @@ class FleetServer:
                 # "storm" event makes this a flight-recorder dump trigger:
                 # the degraded tick is explainable from the artifact.
                 t.storm_ticks += 1
-                tick.per_tenant[t.name].degraded += 1
+                st.degraded += 1
                 self.telemetry.note_supervisor_event("storm", t.name)
                 s.cache.invalidate_snapshot()
                 batches[t.name] = []
                 continue
-            batches[t.name] = s.queue.pop_batch(self.batch_size, now=now)
-            tick.per_tenant[t.name].attempted = len(batches[t.name])
+            # per-TENANT overload governor (sched/overload.py): one
+            # tenant's storm sheds/pauses only that tenant — composing
+            # with the DRF clamp, which bounds a tenant's SHARE while the
+            # governor bounds the control plane's own burn for it
+            gov = s.governor
+            decision = None
+            pop_limit = self.batch_size
+            if gov is not None:
+                decision = gov.begin_wave(now, s.queue.depths())
+                if decision.release_deferred:
+                    released = s.queue.release_deferred(now)
+                    if released:
+                        self.telemetry.note_supervisor_event(
+                            "deferred_release",
+                            f"{t.name}: {released} pods re-admitted")
+                if not decision.dispatch_allowed:
+                    st.commit_paused += 1
+                    batches[t.name] = []
+                    continue
+                if decision.wave_limit:
+                    pop_limit = min(pop_limit, decision.wave_limit)
+            batch = s.queue.pop_batch(pop_limit, now=now)
+            if decision is not None and decision.shed_below is not None \
+                    and batch:
+                kept = []
+                shed_n = 0
+                for pod, attempts in batch:
+                    if pod.priority < decision.shed_below \
+                            and s.queue.park_deferred(pod, attempts,
+                                                      now=now):
+                        shed_n += 1
+                    else:
+                        kept.append((pod, attempts))
+                batch = kept
+                if shed_n:
+                    st.shed += shed_n
+                    gov.note_shed(shed_n)
+            batches[t.name] = batch
+            st.attempted = len(batch)
         span.mark("pump")
 
         from ..sched.supervisor import DispatchAbandonedError
@@ -324,6 +368,14 @@ class FleetServer:
         self._commit_tick(out, tlist, batches, snaps, tick, now)
         span.mark("bind-commit")
         tick.tick_seconds = time.perf_counter() - t0
+        # per-tenant governor feedback: the shared tick's wall time is
+        # every tenant's deadline signal (commit outcomes already fed the
+        # breakers from each tenant's own _commit)
+        for t in tlist:
+            if t.sched.governor is not None:
+                t.sched.governor.end_wave(
+                    now, tick.per_tenant[t.name].attempted,
+                    tick.tick_seconds)
         self._finish_tick(tick, span)
         return tick
 
@@ -584,7 +636,16 @@ class FleetServer:
                                              now=now)
                 commits = []
                 intent = None
-            for pod, node_name, attempts in commits:
+            for ci, (pod, node_name, attempts) in enumerate(commits):
+                if s.governor is not None and not s.governor.commit_allowed():
+                    # this tenant's breaker opened mid-commit: its
+                    # remaining commits requeue promptly (the other
+                    # tenants' loops are untouched — per-tenant breakers)
+                    for pod2, _n2, attempts2 in commits[ci:]:
+                        st.requeued += 1
+                        s.queue.add_prompt_retry(pod2, attempts=attempts2,
+                                                 now=now)
+                    break
                 s._commit(pod, node_name, attempts, now, cycle, st)
             s._retire_intent(intent)
             for pod, attempts in failures:
@@ -614,6 +675,7 @@ class FleetServer:
                               "requeued": st.requeued,
                               "degraded": st.degraded,
                               "drf_clamped": st.drf_clamped,
+                              "shed": st.shed,
                               "aborted": st.aborted}
                        for name, st in tick.per_tenant.items()},
                 extra={"dispatches": tick.dispatches,

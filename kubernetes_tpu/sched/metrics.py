@@ -83,6 +83,34 @@ FLIGHT_DUMPS = REG.counter(
     "scheduler_flight_recorder_dumps_total",
     "Flight-recorder ring dumps, by trigger (abandoned, watchdog_timeout, "
     "degraded, storm, takeover, debug-endpoint, ...)", labels=("trigger",))
+# ISSUE 9 overload governor (sched/overload.py): the brownout mode ladder,
+# the commit-path circuit breaker, and priority-aware shedding — the
+# governor's OWN control signals (per-lane depths ride PENDING_PODS above,
+# now including the deferred lane) must be scrapeable from /metrics. All
+# series carry the GOVERNOR label (the scheduler's name; fleet = the
+# tenant) — per-tenant governors share one registry, and an unlabeled
+# gauge would let tenant B's NORMAL overwrite tenant A's live brownout.
+OVERLOAD_MODE = REG.gauge(
+    "scheduler_overload_mode",
+    "Brownout mode ladder position (0=NORMAL, 1=SHED_LOW, 2=TRICKLE)",
+    labels=("governor",))
+MODE_TRANSITIONS = REG.counter(
+    "scheduler_overload_mode_transitions_total",
+    "Brownout mode transitions, by destination mode",
+    labels=("governor", "to"))
+BREAKER_STATE = REG.gauge(
+    "scheduler_commit_breaker_state",
+    "Commit-path circuit breaker (0=closed, 1=half_open, 2=open)",
+    labels=("governor",))
+BREAKER_TRANSITIONS = REG.counter(
+    "scheduler_commit_breaker_transitions_total",
+    "Commit-path breaker transitions, by destination state",
+    labels=("governor", "to"))
+SHED_PODS = REG.counter(
+    "scheduler_overload_shed_pods_total",
+    "Low-priority pods parked in the deferred lane by the governor "
+    "(deferred, never dropped — they re-admit when shedding ends)",
+    labels=("governor",))
 
 
 def observe_fleet_tick(per_tenant) -> None:
@@ -101,8 +129,19 @@ def observe_fleet_tick(per_tenant) -> None:
             DRF_CLAMPED.inc(st.drf_clamped, tenant=name)
 
 
+def observe_queue_depths(depths) -> None:
+    """Export every queue lane (activeQ/backoffQ/unschedulableQ/deferred)
+    as a `scheduler_pending_pods{queue=...}` gauge — `depths` is
+    `PriorityQueue.depths()`. The overload governor consumes these same
+    numbers; exporting them makes its control signals scrapeable."""
+    for lane, n in depths.items():
+        PENDING_PODS.set(n, queue=lane)
+
+
 def observe_wave(stats, queue_lengths, cache_counts) -> None:
-    """Record one wave's outcome (called from the scheduler server loop)."""
+    """Record one wave's outcome (called from the scheduler server loop).
+    `queue_lengths` is the legacy (active, backoff, unschedulable) tuple
+    or a `PriorityQueue.depths()` dict (which adds the deferred lane)."""
     if stats.attempted:
         SCHEDULING_DURATION.observe(stats.cycle_seconds, operation="wave")
         E2E_SCHEDULING_DURATION.observe(stats.cycle_seconds)
@@ -113,10 +152,13 @@ def observe_wave(stats, queue_lengths, cache_counts) -> None:
         POD_SCHEDULE_ATTEMPTS.inc(stats.unschedulable, result="unschedulable")
     if stats.bind_errors:
         POD_SCHEDULE_ATTEMPTS.inc(stats.bind_errors, result="error")
-    active, backoff, unsched = queue_lengths
-    PENDING_PODS.set(active, queue="active")
-    PENDING_PODS.set(backoff, queue="backoff")
-    PENDING_PODS.set(unsched, queue="unschedulable")
+    if isinstance(queue_lengths, dict):
+        observe_queue_depths(queue_lengths)
+    else:
+        active, backoff, unsched = queue_lengths
+        PENDING_PODS.set(active, queue="active")
+        PENDING_PODS.set(backoff, queue="backoff")
+        PENDING_PODS.set(unsched, queue="unschedulable")
     nodes, pods = cache_counts
     CACHE_SIZE.set(nodes, type="nodes")
     CACHE_SIZE.set(pods, type="pods")

@@ -37,6 +37,12 @@ from ..api.types import Pod
 INITIAL_BACKOFF = 1.0            # podInitialBackoffDuration, scheduling_queue.go:60
 MAX_BACKOFF = 10.0               # podMaxBackoffDuration, scheduling_queue.go:64
 UNSCHEDULABLE_FLUSH_INTERVAL = 60.0  # unschedulableQTimeInterval, :51
+# safety flush for the governor-owned deferred lane (sched/overload.py):
+# shedding parks pods here and releases them when the brownout ends; if the
+# governor never does (process reconfigured mid-flight, KTPU_OVERLOAD
+# toggled), pump() re-admits them after this long — deferred means
+# deferred, never dropped
+DEFERRED_FLUSH_INTERVAL = 300.0
 
 
 @dataclass
@@ -76,6 +82,10 @@ class PriorityQueue:
         self._backoff: List[Tuple[float, int, _Entry]] = []
         self._backoff_keys: Dict[str, _Entry] = {}
         self._unschedulable: Dict[str, _Entry] = {}
+        # governor-owned shed parking (sched/overload.py SHED_LOW): pods
+        # deferred under overload — never dropped, never failed; released
+        # in one batch when the brownout ends (plus pump()'s safety flush)
+        self._deferred: Dict[str, _Entry] = {}
         self._nominated: Dict[str, str] = {}  # pod key -> nominated node name
         # schedulingCycle / moveRequestCycle (scheduling_queue.go:139-147):
         # if a move request happened at-or-after the cycle a pod was popped in,
@@ -93,6 +103,8 @@ class PriorityQueue:
             e = self._backoff_keys.pop(key, None)
         if e is None:
             e = self._unschedulable.pop(key, None)
+        if e is None:
+            e = self._deferred.pop(key, None)
         # heap entries are lazily discarded at pop time via the key maps
         return e
 
@@ -129,6 +141,8 @@ class PriorityQueue:
             self._stamp(pod.key, now)
             if pod.key in self._active_keys or pod.key in self._backoff_keys:
                 return
+            # single-lane rule: a failure verdict supersedes a shed park
+            self._deferred.pop(pod.key, None)
             e = _Entry(pod=pod, attempts=attempts, timestamp=now)
             popped_cycle = self._cycle if cycle is None else cycle
             if self._move_cycle >= popped_cycle:
@@ -144,9 +158,13 @@ class PriorityQueue:
 
     def backoff_duration(self, attempts: int) -> float:
         """Exponential: initial * 2^(attempts-1) capped at max (getBackoffTime,
-        scheduling_queue.go:60-64; bounds from config types.go:96-101)."""
-        return min(self.initial_backoff * (2.0 ** max(attempts - 1, 0)),
-                   self.max_backoff)
+        scheduling_queue.go:60-64; bounds from config types.go:96-101).
+        The exponent clamps BEFORE exponentiating: a storm-requeued pod can
+        accumulate attempts in the thousands, and `2.0 ** 1024` raises
+        OverflowError — the cap must clamp the duration, not crash the
+        queue mid-requeue."""
+        exp = min(max(attempts - 1, 0), 1023)
+        return min(self.initial_backoff * (2.0 ** exp), self.max_backoff)
 
     def update(self, pod: Pod, now: float = 0.0) -> None:
         """Update (scheduling_queue.go:331): spec changes reset the pod's
@@ -200,6 +218,9 @@ class PriorityQueue:
             if pod.key in self._active_keys or pod.key in self._backoff_keys:
                 return
             self._unschedulable.pop(pod.key, None)
+            # a prompt retry PROMOTES a shed-parked pod (single-lane rule:
+            # the deferred entry dies; active wins)
+            self._deferred.pop(pod.key, None)
             e = _Entry(pod=pod, attempts=attempts, timestamp=now)
             self._push_active(e)
 
@@ -229,6 +250,8 @@ class PriorityQueue:
             e = self._backoff_keys.pop(pod.key, None)
             if e is None:
                 e = self._unschedulable.pop(pod.key, None)
+            if e is None:
+                e = self._deferred.pop(pod.key, None)
             attempts = max(attempts, e.attempts if e else 0)
             # the popped backoff-heap tuple (if any) becomes stale and is
             # lazily discarded at pump time via the identity check
@@ -236,22 +259,64 @@ class PriorityQueue:
                                      timestamp=now))
             return "active"
 
+    def park_deferred(self, pod: Pod, attempts: int, now: float = 0.0) -> bool:
+        """Shed parking (sched/overload.py SHED_LOW): a popped low-priority
+        pod is DEFERRED — not failed, not backed off, not dropped — until
+        the governor releases the lane (or pump()'s safety flush does).
+        `attempts` keeps the pre-shed count MINUS the shedding pop itself:
+        being shed is not a scheduling failure, so the pod's next real
+        attempt must not serve escalated backoff for it. Dedupe: a pod
+        already live in another lane keeps that entry (it is on a path to
+        being scheduled; parking it would be a demotion)."""
+        with self._mu:
+            self._stamp(pod.key, now)
+            if (pod.key in self._active_keys or pod.key in self._backoff_keys
+                    or pod.key in self._unschedulable):
+                return False
+            self._deferred[pod.key] = _Entry(
+                pod=pod, attempts=max(attempts - 1, 0), timestamp=now)
+            return True
+
+    def deferred_keys(self) -> List[str]:
+        """Keys currently parked in the deferred lane — the bench/tests
+        prove "deferred then admitted" by intersecting this with the
+        eventually-bound set."""
+        with self._mu:
+            return list(self._deferred)
+
+    def release_deferred(self, now: float = 0.0) -> int:
+        """Brownout over: re-admit the whole deferred lane to activeQ in
+        one batch (the governor's NORMAL-exit action). Attempts carry."""
+        with self._mu:
+            n = 0
+            for key, e in list(self._deferred.items()):
+                del self._deferred[key]
+                if key in self._active_keys:
+                    continue
+                e.timestamp = now
+                self._push_active(e)
+                n += 1
+            return n
+
     def get_pod(self, key: str) -> Optional[Pod]:
-        """The pod behind `key` in WHICHEVER lane holds it (active, backoff
-        or unschedulable), else None. Intent replay's default informer-truth
-        lookup reads this: a pod parked in backoff at crash time is still a
-        live pending pod, not a deleted one."""
+        """The pod behind `key` in WHICHEVER lane holds it (active, backoff,
+        unschedulable or deferred), else None. Intent replay's default
+        informer-truth lookup reads this: a pod parked in backoff at crash
+        time is still a live pending pod, not a deleted one."""
         with self._mu:
             e = (self._active_keys.get(key)
                  or self._backoff_keys.get(key)
-                 or self._unschedulable.get(key))
+                 or self._unschedulable.get(key)
+                 or self._deferred.get(key))
             return e.pod if e is not None else None
 
     def lanes(self, key: str) -> Tuple[bool, bool, bool]:
         """(in activeQ, in backoffQ, in unschedulableQ) membership — the
         dedupe introspection the crash-requeue tests assert with (a pod must
         never be live in two lanes; heap leftovers don't count, the key maps
-        are the ground truth the pop paths honor)."""
+        are the ground truth the pop paths honor). The deferred lane is
+        introspected via depths()/get_pod (this tuple's shape is a stable
+        test contract)."""
         with self._mu:
             return (key in self._active_keys, key in self._backoff_keys,
                     key in self._unschedulable)
@@ -319,6 +384,13 @@ class PriorityQueue:
                 if now - e.timestamp >= UNSCHEDULABLE_FLUSH_INTERVAL:
                     del self._unschedulable[key]
                     self._push_active(e)
+            # deferred safety flush: a wedged/removed governor must never
+            # strand shed pods — deferred means deferred, not dropped
+            for key, e in list(self._deferred.items()):
+                if now - e.timestamp >= DEFERRED_FLUSH_INTERVAL:
+                    del self._deferred[key]
+                    if key not in self._active_keys:
+                        self._push_active(e)
 
     # ------------------------------------------------------------------ #
     # nominated pods (preemption bookkeeping, scheduling_queue.go:136-138)
@@ -352,7 +424,18 @@ class PriorityQueue:
 
     def lengths(self) -> Tuple[int, int, int]:
         """(active, backoff, unschedulable) — the pending-pods queue-depth
-        recorders (scheduling_queue.go:237-243)."""
+        recorders (scheduling_queue.go:237-243). Kept a 3-tuple (a stable
+        contract across callers/tests); the deferred lane rides depths()."""
         with self._mu:
             return (len(self._active_keys), len(self._backoff_keys),
                     len(self._unschedulable))
+
+    def depths(self) -> Dict[str, int]:
+        """Every lane's depth, by name — the overload governor's pressure
+        signal and the `scheduler_pending_pods{queue=...}` gauge source
+        (sched/metrics.py observe_queue_depths), deferred included."""
+        with self._mu:
+            return {"active": len(self._active_keys),
+                    "backoff": len(self._backoff_keys),
+                    "unschedulable": len(self._unschedulable),
+                    "deferred": len(self._deferred)}

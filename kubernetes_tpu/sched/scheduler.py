@@ -82,6 +82,12 @@ class CycleStats:
     # counters instead of scraping logs.
     requeued: int = 0
     degraded: int = 0
+    # overload governor (sched/overload.py): pods parked in the deferred
+    # lane this wave (SHED_LOW — deferred, never dropped), and whether the
+    # wave was paused outright by the open commit breaker (no pop, no
+    # device time)
+    shed: int = 0
+    commit_paused: int = 0
     # pods deferred by the DRF quota pre-mask this tick (fleet/server.py;
     # a subset of `requeued`) — routed through sched/metrics.py
     # observe_fleet_tick so the fleet bench asserts the clamp from the
@@ -204,6 +210,17 @@ class Scheduler:
         if self.telemetry.enabled:
             self.queue.tracker = self.telemetry.tracker
         self.supervisor.event_sink = self.telemetry.note_supervisor_event
+        # overload governor (sched/overload.py, ISSUE 9): brownout modes,
+        # priority-aware shedding into the queue's deferred lane, adaptive
+        # wave sizing, and the commit-path circuit breaker. None when
+        # KTPU_OVERLOAD=0 — the kill switch keeps the wave pipeline
+        # byte-for-byte the pre-governor code path.
+        from .overload import build_governor
+
+        self.governor = build_governor(
+            batch_size, clock=self.clock,
+            event_sink=self.telemetry.note_supervisor_event,
+            name=scheduler_name)
 
     @staticmethod
     def _make_mesh_state(mesh):
@@ -338,16 +355,16 @@ class Scheduler:
                 self.telemetry.dump("exception")
             raise
 
-    def _drain_idle_events(self, span, stats) -> None:
+    def _drain_idle_events(self, span, stats, engine: str = "idle") -> None:
         """Supervisor events (a prewarm compile failure, a prober
-        recovery) can land while the queue is idle; an idle/early-return
-        wave must still drain them into a record — auto-dumping on a
-        trigger — instead of leaving them to be misattributed to the next
-        busy wave. Event-free idle waves record nothing, so the ring
-        stays signal."""
+        recovery, a breaker/mode transition) can land while the queue is
+        idle; an idle/early-return/paused wave must still drain them into
+        a record — auto-dumping on a trigger — instead of leaving them to
+        be misattributed to the next busy wave. Event-free idle waves
+        record nothing, so the ring stays signal."""
         if self.telemetry.has_pending_events():
-            span.mark("idle")
-            self.telemetry.finish_wave(span, stats=stats, engine="idle")
+            span.mark(engine)
+            self.telemetry.finish_wave(span, stats=stats, engine=engine)
 
     def _run_wave(self, span, now: float, t0: float,
                   ctx: Dict[str, object]) -> CycleStats:
@@ -355,10 +372,51 @@ class Scheduler:
         self.cache.cleanup(now)
         self.expire_waiting(now)
         span.mark("pump")
-        batch = self.queue.pop_batch(self.batch_size, now=now)
+        # ---- overload governor gate (sched/overload.py): mode ladder,
+        # breaker pause, wave-size clamp — decided BEFORE the pop so a
+        # paused wave burns no device time and pops nothing it cannot
+        # commit (intents are only ever written downstream of this gate,
+        # so the bind-intent ledger cannot be orphaned by a brownout) ---- #
+        gov = self.governor
+        decision = None
+        pop_limit = self.batch_size
+        if gov is not None:
+            decision = gov.begin_wave(now, self.queue.depths())
+            if decision.release_deferred:
+                released = self.queue.release_deferred(now)
+                if released:
+                    self.telemetry.note_supervisor_event(
+                        "deferred_release", f"{released} pods re-admitted")
+            if not decision.dispatch_allowed:
+                stats = CycleStats(commit_paused=1)
+                ctx["stats"] = stats
+                stats.cycle_seconds = time.perf_counter() - t0
+                # only the transition wave records (the breaker_open event
+                # rides it); a long pause must not flood the ring
+                self._drain_idle_events(span, stats, engine="paused")
+                return stats
+            if decision.wave_limit:
+                pop_limit = min(pop_limit, decision.wave_limit)
+        batch = self.queue.pop_batch(pop_limit, now=now)
         cycle = self.queue.current_cycle()
         span.mark("pop")
-        stats = CycleStats(attempted=len(batch))
+        # ---- priority-aware shedding (SHED_LOW/TRICKLE): park sheddable
+        # pods in the deferred lane — deferred, never dropped, no failure
+        # verdict, no backoff escalation; high-priority pods continue
+        # bit-for-bit through the unchanged pipeline ---- #
+        shed_n = 0
+        if decision is not None and decision.shed_below is not None and batch:
+            kept: List[Tuple[Pod, int]] = []
+            for pod, attempts in batch:
+                if pod.priority < decision.shed_below \
+                        and self.queue.park_deferred(pod, attempts, now=now):
+                    shed_n += 1
+                else:
+                    kept.append((pod, attempts))
+            batch = kept
+            if shed_n:
+                gov.note_shed(shed_n)
+        stats = CycleStats(attempted=len(batch), shed=shed_n)
         ctx["stats"] = stats
 
         # pods an extender is interested in take the per-pod extender path
@@ -377,6 +435,9 @@ class Scheduler:
             for pod, attempts in ext_batch:
                 self._schedule_one_with_extenders(pod, attempts, now, cycle, stats)
             stats.cycle_seconds = time.perf_counter() - t0
+            if self.governor is not None:
+                self.governor.end_wave(now, stats.attempted,
+                                       stats.cycle_seconds)
             # an extender-only wave did REAL work (per-pod dispatches that
             # can degrade/abandon): it gets its own record, never "idle"
             span.mark("extenders")
@@ -615,7 +676,20 @@ class Scheduler:
             commits = []
             intent = None
         span.mark("intent-write")
-        for pod, node_name, attempts in commits:
+        for ci, (pod, node_name, attempts) in enumerate(commits):
+            if self.governor is not None \
+                    and not self.governor.commit_allowed():
+                # the breaker OPENED mid-wave (this wave's own commits
+                # tripped it): stop burning the commit path — the rest of
+                # the wave requeues promptly, no failure verdict. The
+                # intent stays valid (write-ahead covers the whole wave;
+                # unbound entries replay safely against informer truth)
+                # and is retired below as usual.
+                for pod2, _n2, attempts2 in commits[ci:]:
+                    stats.requeued += 1
+                    self.queue.add_prompt_retry(pod2, attempts=attempts2,
+                                                now=now)
+                break
             self._commit(pod, node_name, attempts, now, cycle, stats)
         span.mark("bind-commit")
         self._retire_intent(intent)
@@ -655,6 +729,9 @@ class Scheduler:
 
         span.mark("requeue")
         stats.cycle_seconds = time.perf_counter() - t0
+        if self.governor is not None:
+            self.governor.end_wave(now, stats.attempted,
+                                   stats.cycle_seconds)
         self.telemetry.finish_wave(span, stats=stats, engine=wave_engine,
                                    dims=snap.dims, rc=rc)
         return stats
@@ -998,7 +1075,13 @@ class Scheduler:
             if not st.is_success:
                 rollback(as_bind_error=False)
                 return
+        tb0 = time.perf_counter()
         ok = self._run_bind(state, pod, node_name, binder_ext)
+        if self.governor is not None:
+            # commit-path breaker feed: outcome + wall latency of the
+            # Binding write (wall time, not the injected clock — the SLO
+            # is about real apiserver round-trips)
+            self.governor.note_commit(ok, time.perf_counter() - tb0)
 
         if ok:
             self.cache.finish_binding(pod.key, now)
@@ -1114,6 +1197,9 @@ class Scheduler:
             total.unschedulable += s.unschedulable
             total.bind_errors += s.bind_errors
             total.aborted += s.aborted
+            total.shed += s.shed
+            total.requeued += s.requeued
+            total.commit_paused += s.commit_paused
             if s.class_runs:
                 # run-collapse telemetry: keep the last non-empty wave's
                 total.class_runs = s.class_runs

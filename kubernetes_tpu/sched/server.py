@@ -43,12 +43,28 @@ class APIBinder:
     Fenced: with a `fence_source` attached (leader election), every Binding
     is stamped with the current lease generation so the apiserver can
     reject a deposed leader's write (api.types.FENCING_TOKEN_ANNOTATION;
-    apiserver/server.py `bind_pod`)."""
+    apiserver/server.py `bind_pod`).
+
+    Retry budget (ISSUE 9): server PUSHBACK — 429 TooManyRequests from the
+    max-inflight filter, 503 from a restarting apiserver — is retried
+    through ONE shared implementation of the backoff semantics
+    (client/rest.py RetryPolicy: capped exponential + jitter, the Status'
+    `retryAfterSeconds` honored as a floor, per-bind deadline). Both 429
+    and 503 are rejected BEFORE the Binding mutates anything, so the
+    retry can never double-apply. Everything else (fenced 409,
+    already-assigned, NotFound) still fails fast — persistent pushback
+    past the budget is the commit breaker's job (sched/overload.py),
+    not the binder's."""
 
     def __init__(self, client, volume_binder=None, pod_lookup=None,
                  fence_source=None,
-                 fence_lease: str = ""):
+                 fence_lease: str = "",
+                 retry_budget: int = 3,
+                 retry_base_s: float = 0.05,
+                 retry_cap_s: float = 1.0,
+                 bind_deadline_s: float = 3.0):
         from kubernetes_tpu.api.types import DEFAULT_FENCING_LEASE
+        from kubernetes_tpu.client.rest import RetryPolicy
 
         self.client = client
         self.volume_binder = volume_binder
@@ -56,6 +72,15 @@ class APIBinder:
         self.fence_source = fence_source  # () -> int lease generation
         self.fence_lease = fence_lease or DEFAULT_FENCING_LEASE
         self.stale_rejects = 0  # fenced-off binds (the mechanism working)
+        self.pushback_retries = 0  # 429/503 absorbed by the budget
+        self.pushback_failures = 0  # budget/deadline exhausted
+        self.retry = RetryPolicy(attempts=retry_budget, base_s=retry_base_s,
+                                 cap_s=retry_cap_s,
+                                 deadline_s=bind_deadline_s,
+                                 on_retry=self._note_pushback_retry)
+
+    def _note_pushback_retry(self) -> None:
+        self.pushback_retries += 1
 
     def bind(self, pod: Pod, node_name: str) -> bool:
         from kubernetes_tpu.api.types import (FENCED_BIND_MARKER,
@@ -73,13 +98,16 @@ class APIBinder:
                 FENCING_LEASE_ANNOTATION: self.fence_lease,
             }
         try:
-            self.client.pods.bind(pod.name, node_name, pod.namespace,
-                                  uid=pod.uid, annotations=annotations)
+            self.retry.run(lambda: self.client.pods.bind(
+                pod.name, node_name, pod.namespace,
+                uid=pod.uid, annotations=annotations))
             return True
         except errors.StatusError as e:
             if annotations is not None and errors.is_conflict(e) \
                     and FENCED_BIND_MARKER in str(e):
                 self.stale_rejects += 1
+            elif e.code in (429, 503):
+                self.pushback_failures += 1
             return False
 
 
@@ -556,7 +584,9 @@ class SchedulerServer:
                 stats = self.scheduler.schedule_pending()
             except Exception:  # noqa: BLE001 — the loop never dies
                 return None
-            queue_lengths = self.scheduler.queue.lengths()
+            # depths() carries the deferred lane too — the governor's own
+            # control signals become scrapeable gauges
+            queue_lengths = self.scheduler.queue.depths()
             cache_counts = (len(self.scheduler.cache.nodes()),
                             len(self.scheduler.cache.scheduled_pods()))
         sched_metrics.observe_wave(stats, queue_lengths, cache_counts)

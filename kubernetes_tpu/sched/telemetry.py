@@ -50,7 +50,8 @@ from .metrics import FLIGHT_DUMPS, POD_E2E_LATENCY, SCHEDULING_DURATION
 #: supervisor/tick event kinds that auto-dump the ring when they appear on
 #: a wave record (the "explainable without logs" triggers of ISSUE 7),
 #: most severe first — the dump is labelled with the worst event present
-DUMP_TRIGGERS = ("abandoned", "watchdog_timeout", "storm", "degraded")
+DUMP_TRIGGERS = ("abandoned", "watchdog_timeout", "storm", "breaker_open",
+                 "degraded")
 
 #: canonical serving-wave phase order (the scheduler marks a subset; fleet
 #: ticks add stack-refresh/solo phases) — tests assert ordering against it
@@ -310,6 +311,7 @@ class SchedulerTelemetry:
                 "aborted": stats.aborted,
                 "requeued": getattr(stats, "requeued", 0),
                 "degraded": getattr(stats, "degraded", 0),
+                "shed": getattr(stats, "shed", 0),
             }
         if events:
             rec["supervisor_events"] = events

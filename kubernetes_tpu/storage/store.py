@@ -172,6 +172,16 @@ class Storage:
                     resource, name or key,
                     "the object has been modified; please apply your changes "
                     "to the latest version and try again")
+            if faultline.should("store.latency", "guaranteed_update"):
+                # chaos: the storage backend (etcd) is slow — every hit
+                # read-transform-write stalls KTPU_SLOW_S. The bind-intent
+                # writes and Lease renews ride this path, so the overload
+                # drills use it to slow the COMMIT side without touching
+                # the watch/ingest side.
+                import os as _os
+                import time as _time
+
+                _time.sleep(float(_os.environ.get("KTPU_SLOW_S", "0.2")))
             updated = update_fn(meta.deep_copy(cur))
             if not chaos_cas and faultline.should("store.cas_conflict",
                                                   "guaranteed_update"):

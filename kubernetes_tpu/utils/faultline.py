@@ -37,6 +37,27 @@ Seams wired in this repo (fault name → injection point):
     watch.drop / watch.relist                 client/informers.py reflector
     native.dlopen                             storage/native.py new_kv()
     apiserver.restart                         apiserver/server.py handle_rest
+    apiserver.slow                            apiserver/server.py (sites:
+                                              handle_rest = every hit
+                                              request stalls KTPU_SLOW_S
+                                              before routing; bind = only
+                                              the pods/binding commit path
+                                              stalls) — the overload
+                                              drills' commit-latency-SLO
+                                              breach switch (ISSUE 9)
+    store.latency                             storage/store.py
+                                              guaranteed_update (site:
+                                              guaranteed_update): a slow
+                                              etcd — bind intents and
+                                              Lease renews stall
+                                              KTPU_SLOW_S per hit write
+    watch.storm                               client/informers.py reflector
+                                              (site: informer): forces a
+                                              relist — the whole world
+                                              redelivers as one burst of
+                                              upserts, the ingest-side
+                                              storm the overload governor's
+                                              pressure signal reacts to
     proc.crash                                sched/scheduler.py bind
                                               lifecycle + sched/ledger.py
                                               reconciliation (sites:

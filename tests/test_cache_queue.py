@@ -188,3 +188,92 @@ class TestPriorityQueue:
         q.add(pod("a"))
         assert q.lengths()[0] == 1
         assert len(q.pop_batch(10)) == 1
+
+
+class TestStormBackoffBoundaries:
+    """ISSUE 9 satellite: backoff boundaries under storm requeues — the
+    clamp must hold (not crash) at attempt counts a storm accumulates,
+    and a pod requeued from the shed + prompt-retry paths in one tick
+    must land in exactly ONE lane."""
+
+    def test_backoff_clamps_at_max_for_large_attempts(self):
+        q = PriorityQueue()
+        # pre-fix, 2.0 ** (attempts - 1) raised OverflowError past ~1024
+        for attempts in (64, 1025, 2000, 10**6, 2**31):
+            assert q.backoff_duration(attempts) == MAX_BACKOFF
+        assert q.backoff_duration(0) == INITIAL_BACKOFF
+        assert q.backoff_duration(-5) == INITIAL_BACKOFF
+        # the clamp also survives custom bounds
+        q2 = PriorityQueue(initial_backoff=0.5, max_backoff=7.0)
+        assert q2.backoff_duration(100000) == 7.0
+
+    def test_huge_attempts_requeue_does_not_crash(self):
+        q = PriorityQueue()
+        q.add(pod("a"))
+        (p, _attempts), = q.pop_batch(1, now=0.0)
+        q.add_unschedulable(p, attempts=5000, now=0.0)
+        q.move_all_to_active(now=0.1)     # serves remaining-backoff math
+        assert q.lengths() == (0, 1, 0)   # parked at the 10s cap
+        q.pump(now=0.1 + MAX_BACKOFF)
+        assert q.lengths() == (1, 0, 0)
+
+    def test_shed_then_prompt_retry_single_lane(self):
+        """A pod parked by the shed path and requeued by prompt-retry in
+        the same tick must be live in exactly one lane (active wins —
+        prompt retry is a promotion, the deferred entry dies)."""
+        q = PriorityQueue()
+        q.add(pod("a"))
+        (p, attempts), = q.pop_batch(1, now=0.0)
+        assert q.park_deferred(p, attempts, now=0.0)
+        assert q.depths()["deferred"] == 1
+        q.add_prompt_retry(p, attempts, now=0.0)
+        d = q.depths()
+        assert (d["active"], d["backoff"], d["deferred"]) == (1, 0, 0)
+        assert len(q.pop_batch(10)) == 1  # exactly one live entry
+
+    def test_prompt_retry_then_shed_single_lane(self):
+        """The reverse order: a pod already promoted to activeQ refuses
+        the park (shedding it would demote a pod on its way to a wave)."""
+        q = PriorityQueue()
+        q.add(pod("a"))
+        (p, attempts), = q.pop_batch(1, now=0.0)
+        q.add_prompt_retry(p, attempts, now=0.0)
+        assert not q.park_deferred(p, attempts, now=0.0)
+        d = q.depths()
+        assert (d["active"], d["deferred"]) == (1, 0)
+
+    def test_deferred_release_and_safety_flush(self):
+        from kubernetes_tpu.sched.queue import DEFERRED_FLUSH_INTERVAL
+
+        q = PriorityQueue()
+        q.add(pod("a"))
+        q.add(pod("b"))
+        batch = q.pop_batch(2, now=0.0)
+        for p, attempts in batch:
+            q.park_deferred(p, attempts, now=0.0)
+        assert q.depths()["deferred"] == 2
+        assert q.get_pod("default/a") is not None  # visible to replay
+        assert q.release_deferred(now=1.0) == 2
+        assert q.depths() == {"active": 2, "backoff": 0,
+                              "unschedulable": 0, "deferred": 0}
+        # safety flush: a parked pod outlives a wedged governor
+        (p, attempts), *_ = q.pop_batch(2, now=1.0)
+        q.park_deferred(p, attempts, now=1.0)
+        q.pump(now=1.0 + DEFERRED_FLUSH_INTERVAL)
+        assert q.depths()["deferred"] == 0
+        assert q.lengths()[0] >= 1
+
+    def test_deferred_delete_and_update(self):
+        q = PriorityQueue()
+        q.add(pod("a"))
+        (p, attempts), = q.pop_batch(1, now=0.0)
+        q.park_deferred(p, attempts, now=0.0)
+        q.delete("default/a")                 # pod deleted while parked
+        assert q.depths()["deferred"] == 0
+        assert q.get_pod("default/a") is None
+        q.add(pod("b"))
+        (p2, a2), = q.pop_batch(1, now=0.0)
+        q.park_deferred(p2, a2, now=0.0)
+        q.update(p2, now=0.0)                 # spec change un-parks it
+        d = q.depths()
+        assert (d["active"], d["deferred"]) == (1, 0)
