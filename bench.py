@@ -83,6 +83,14 @@ DEFAULT_STAGES = [
                                 # (deferred, never dropped), commit
                                 # breaker opens and closes, recovery to
                                 # NORMAL <= 30 s, kill-switch bit-equality
+    (1000, 10000, "explain"),  # ISSUE 10: decision provenance — on-device
+                               # attribution of a deliberately
+                               # unschedulable cohort: <=2% overhead vs
+                               # KTPU_EXPLAIN=0 (interleaved rounds),
+                               # FailedScheduling events through the
+                               # apiserver with correct dominant-reason
+                               # counts, dedupe proven, kill-switch
+                               # placement bit-equality
     (5000, 50000, "classes"),  # run-collapsed admission vs the per-pod
                                # scan on a 200-class deployment backlog:
                                # bit-equal placements, ≥10× fewer scan steps
@@ -130,6 +138,9 @@ CYCLE_BUDGETS = {
     ("overload", 1000): 60.0,    # worst storm wave: the slow-bind drill
                                  # stalls ~8 commits before the breaker
                                  # opens mid-wave and cuts the rest
+    ("explain", 1000): 30.0,     # worst steady wave with attribution on
+                                 # (the 2% overhead claim lives in
+                                 # METRIC_BUDGETS; this bounds box stalls)
     ("classes", 5000): 60.0,     # the run-collapsed dispatch at 5k×50k
                                  # (the stage also times the per-pod scan
                                  # for the speedup check — budgeted via
@@ -229,6 +240,21 @@ METRIC_BUDGETS = {
                          "mode_transitions": (">=", 2),
                          "recovery_to_normal_s": ("<=", 30.0),
                          "kill_switch_bit_equal": (">=", 1)},
+    # ISSUE 10 acceptance: attribution costs <= 2% of wave pods/s vs
+    # KTPU_EXPLAIN=0 (interleaved drain rounds, the PR 7 overhead
+    # pattern); >= 1 FailedScheduling event observed THROUGH the apiserver
+    # with the correct dominant-reason count (the whole unschedulable
+    # cohort fails fit on every valid node, so the leading count must be
+    # exactly node_count); the reasons metric actually fired; dedupe is
+    # proven (event writes way below unschedulable pod-wave verdicts);
+    # nothing lost; and KTPU_EXPLAIN=0 placements are bit-equal
+    ("explain", 1000): {"attribution_overhead_pct": ("<=", 2.0),
+                        "events_observed": (">=", 1),
+                        "event_dominant_correct": (">=", 1),
+                        "reasons_recorded": (">=", 1),
+                        "dedupe_proven": (">=", 1),
+                        "lost_pods": ("<=", 0),
+                        "explain_bit_equal": (">=", 1)},
     ("mesh", 5000): {"bit_equal": (">=", 1),
                      "resident_full_uploads": ("<=", 1),
                      "donated_patches": (">=", 1),
@@ -313,6 +339,13 @@ def _run_stage(n_nodes, n_pods, kind, env, timeout):
     # an ambient KTPU_MESH would silently mesh-back the single-device
     # baselines — including the mesh stage's own bit-equality reference
     env.pop("KTPU_MESH", None)
+    if kind != "explain":
+        # provenance isolation (same discipline as KTPU_MESH/KTPU_OVERLOAD):
+        # only the explain stage measures attribution — an ambient
+        # KTPU_EXPLAIN would tax every other stage's budgets with the
+        # attribution tail and route dispatches off the prewarmed
+        # executables
+        env.pop("KTPU_EXPLAIN", None)
     if kind != "overload":
         # same isolation discipline for the overload governor: every
         # other stage measures ITS subsystem's budgets, and an adaptive
@@ -1841,6 +1874,200 @@ def _overload_stage(n_nodes, n_pods):
     }))
 
 
+def _explain_stage(n_nodes, n_pods):
+    """ISSUE 10 acceptance stage: decision provenance on the flagship shape
+    with a DELIBERATELY unschedulable cohort (pods requesting more CPU than
+    any node holds — every valid node rejects them on exactly the fit
+    predicate). What the budgets prove:
+
+      * attribution overhead <= 2% of wave pods/s vs KTPU_EXPLAIN=0,
+        measured by interleaved drain-to-idle rounds (the PR 7 telemetry-
+        overhead pattern: box-load drift hits both modes symmetrically);
+      * >= 1 FailedScheduling event lands THROUGH the apiserver (the
+        APIEventSink writes v1 Events on the PR 8 retry budget) and its
+        dominant reason count is exactly the node count — the on-device
+        reduction, the kube-style renderer and the event path agree;
+      * scheduler_unschedulable_reasons_total actually fired;
+      * dedupe proven: event writes are a small fraction of the cohort's
+        unschedulable pod-wave verdicts (the per-(pod, fingerprint)
+        exponential backoff absorbed the repeats);
+      * nothing lost, and KTPU_EXPLAIN=0 placements are bit-equal to the
+        explain-on run (attribution is a pure observer)."""
+    import jax
+
+    from kubernetes_tpu.api.types import Pod, Resources
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.rest import Client
+    from kubernetes_tpu.models.workloads import make_nodes
+    from kubernetes_tpu.sched.explain import APIEventSink
+    from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+    from kubernetes_tpu.state.dims import Dims, bucket
+
+    batch = min(4096, max(64, n_pods // 4))
+    base = Dims(N=bucket(n_nodes), P=bucket(batch),
+                E=bucket(2 * batch + 256))
+    nodes = make_nodes(n_nodes)
+    cohort = 64  # the deliberately unschedulable pods
+
+    # deterministic advanceable clock: each cohort re-admission round
+    # advances it past the max backoff, so the repeated-failure rounds the
+    # dedupe proof needs cost no wall-clock waiting
+    clk = [0.0]
+
+    def mk(explain_on):
+        os.environ["KTPU_EXPLAIN"] = "1" if explain_on else "0"
+        s = Scheduler(binder=RecordingBinder(), batch_size=batch,
+                      base_dims=base, clock=lambda: clk[0])
+        s.prewarmer.enabled = False
+        for n in nodes:
+            s.on_node_add(n)
+        return s
+
+    def mkpod(prefix, i, cpu="20m"):
+        return Pod(name=f"{prefix}-{i}",
+                   requests=Resources.make(cpu=cpu, memory="16Mi"),
+                   creation_index=i)
+
+    def drain(s, prefix, count):
+        in_flight = {}
+        for i in range(count):
+            p = mkpod(prefix, i)
+            in_flight[p.key] = p
+            s.on_pod_add(p)
+        waves = []
+        while s.queue.lengths()[0] > 0 and len(waves) < 64:
+            c0 = time.perf_counter()
+            st = s.schedule_pending()
+            waves.append((time.perf_counter() - c0, st.scheduled))
+            _churn(s, st)
+        return waves
+
+    def best_pps(waves):
+        full = [(sec, n) for sec, n in waves if n >= batch // 2]
+        return max((n / sec for sec, n in (full or waves)), default=0.0)
+
+    # ---- kill-switch placement bit-equality (small healthy run) -------- #
+    def _mini_assignments(explain_on):
+        prev = os.environ.get("KTPU_EXPLAIN")
+        try:
+            os.environ["KTPU_EXPLAIN"] = "1" if explain_on else "0"
+            s = Scheduler(binder=RecordingBinder(), batch_size=256,
+                          base_dims=base)
+            s.prewarmer.enabled = False
+            for n in nodes[:200]:
+                s.on_node_add(n)
+            for i in range(1000):
+                s.on_pod_add(mkpod("eq", i))
+            return dict(s.run_until_idle().assignments)
+        finally:
+            if prev is None:
+                os.environ.pop("KTPU_EXPLAIN", None)
+            else:
+                os.environ["KTPU_EXPLAIN"] = prev
+
+    explain_bit_equal = int(
+        _mini_assignments(True) == _mini_assignments(False))
+
+    # ---- main run: provenance ON, events through a real apiserver ------ #
+    api = APIServer()
+    client = Client.local(api)
+    s_on = mk(True)
+    s_on.explainer.sink = APIEventSink(client, component="bench-explain")
+    drain(s_on, "warm", batch)  # compile outside the measured window
+
+    t0 = time.monotonic()
+    sched_total = 0
+    unsched_verdicts = 0
+    waves = []
+    # schedulable backlog + the unschedulable cohort
+    in_flight = {}
+    for i in range(n_pods - cohort):
+        p = mkpod("ok", i)
+        in_flight[p.key] = p
+        s_on.on_pod_add(p)
+    for i in range(cohort):
+        s_on.on_pod_add(mkpod("stuck", i, cpu="99999"))
+    rounds = 0
+    while True:
+        c0 = time.perf_counter()
+        st = s_on.schedule_pending()
+        if st.attempted:
+            waves.append(time.perf_counter() - c0)
+        sched_total += st.scheduled
+        unsched_verdicts += st.unschedulable
+        _churn(s_on, st)
+        if s_on.queue.lengths()[0] == 0:
+            # 24 re-admission rounds: the correlator emits at occurrence
+            # counts 1,2,4,8,16 → 5 writes per pod against 25 verdicts,
+            # which is what makes the >=4x dedupe ratio provable
+            if rounds >= 24:
+                break
+            # re-admit the parked cohort: every extra failure round is a
+            # dedupe datapoint (the correlator must absorb the repeats).
+            # Advancing the injected clock past the max backoff makes the
+            # round instant instead of a wall-clock backoff wait.
+            clk[0] += 61.0
+            s_on.queue.move_all_to_active(s_on.clock())
+            s_on.queue.pump(s_on.clock())
+            rounds += 1
+        if time.monotonic() - t0 > 300:
+            break
+    t_run = time.monotonic() - t0
+    lost = (n_pods - cohort) - sched_total
+    sink = s_on.explainer.sink
+
+    # ---- the events, read back through the apiserver ------------------ #
+    evs = client.events.list("default").get("items", [])
+    failed_evs = [e for e in evs if e.get("reason") == "FailedScheduling"]
+    events_observed = len(failed_evs)
+    valid_n = n_nodes
+    dominant_ok = 0
+    for e in failed_evs:
+        msg = e.get("message", "")
+        if msg.startswith(f"0/{valid_n} nodes are available: {valid_n} "):
+            dominant_ok = 1
+            break
+    from kubernetes_tpu.sched.metrics import UNSCHEDULABLE_REASONS
+
+    reasons_recorded = int(UNSCHEDULABLE_REASONS.total())
+    # dedupe: the cohort failed `unsched_verdicts` pod-waves but the
+    # correlator let only O(cohort * log(rounds)) writes through
+    dedupe_proven = int(unsched_verdicts > 0 and sink.writes > 0
+                        and sink.writes * 4 <= unsched_verdicts)
+
+    # ---- attribution overhead: interleaved drain rounds, on vs off ---- #
+    s_off = mk(False)
+    drain(s_off, "warm-off", batch)
+    waves_on, waves_off = [], []
+    for rnd in range(2):
+        waves_off += drain(s_off, f"ovh-off{rnd}", n_pods // 2)
+        waves_on += drain(s_on, f"ovh-on{rnd}", n_pods // 2)
+    os.environ.pop("KTPU_EXPLAIN", None)
+    pps_on, pps_off = best_pps(waves_on), best_pps(waves_off)
+    overhead_pct = max(0.0, (pps_off - pps_on) / pps_off * 100.0) \
+        if pps_off else 0.0
+
+    print(json.dumps({
+        "nodes": n_nodes, "pods": n_pods, "kind": "explain",
+        "scheduled": sched_total, "failed": max(lost, 0),
+        "unsched_verdicts": unsched_verdicts,
+        "events_observed": events_observed,
+        "event_writes": sink.writes,
+        "events_deduped": s_on.explainer.events_deduped,
+        "event_dominant_correct": dominant_ok,
+        "reasons_recorded": reasons_recorded,
+        "dedupe_proven": dedupe_proven,
+        "attribution_overhead_pct": round(overhead_pct, 2),
+        "pods_per_sec_explain_off": round(pps_off, 1),
+        "explain_bit_equal": explain_bit_equal,
+        "lost_pods": max(lost, 0),
+        "run_seconds": round(t_run, 2),
+        "cycle_seconds": round(max(waves), 3) if waves else 0.0,
+        "pods_per_sec": round(pps_on, 1),
+        "backend": jax.default_backend(),
+    }))
+
+
 def _churn(s, stats):
     """Completed-pod churn for the resident-scheduler stages: a bound pod
     completes and leaves, keeping the cache (and the E bucket) bounded."""
@@ -2015,6 +2242,9 @@ def _stage_main(n_nodes, n_pods, kind):
     if kind == "overload":
         _overload_stage(n_nodes, n_pods)
         return
+    if kind == "explain":
+        _explain_stage(n_nodes, n_pods)
+        return
     if kind == "probe":
         _probe_stage()
         return
@@ -2178,6 +2408,10 @@ def _compact_line(full, out_name, wrote):
                 e["mode_transitions"] = r.get("mode_transitions")
                 e["breaker_opens"] = r.get("breaker_opens")
                 e["shed_p99_ms"] = r.get("shed_p99_ms")
+            if r.get("kind") == "explain":
+                e["events"] = r.get("events_observed")
+                e["dedupe"] = r.get("dedupe_proven")
+                e["ovh_pct"] = r.get("attribution_overhead_pct")
             if r.get("kind") == "multichip":
                 e["out"] = r.get("out")
             if r.get("within_budget") is False:
@@ -2384,6 +2618,13 @@ def _summarize(results, backend, probe_diags):
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--trend":
+        # the post-run check (scripts/bench_trend.py): diff the newest two
+        # BENCH_rNN.json artifacts, exit nonzero on budget-metric
+        # regressions beyond tolerance
+        from scripts.bench_trend import main as _trend_main
+
+        sys.exit(_trend_main(sys.argv[2:]))
     if len(sys.argv) >= 4 and sys.argv[1] == "--stage":
         _stage_main(int(sys.argv[2]), int(sys.argv[3]),
                     sys.argv[4] if len(sys.argv) > 4 else "flagship")

@@ -11,6 +11,7 @@ and watch with initial-events synthesis.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.machinery import errors, labels as mlabels, meta
@@ -80,8 +81,37 @@ class Store:
         self.after_create = after_create
         self.after_update = after_update
         self.after_delete = after_delete
+        # TTL-bounded storage (ISSUE 10 — the events resource, the analog
+        # of kube-apiserver's --event-ttl etcd leases): 0 = objects live
+        # forever (every other resource); > 0 = objects whose freshness
+        # stamp (lastTimestamp for Events, else creationTimestamp) ages
+        # past this many seconds are pruned lazily at read time — list()
+        # sweeps them, get() 404s them. Deletes flow through the ordinary
+        # storage path, so watchers observe DELETED events.
+        self.ttl_seconds: float = 0.0
         self._name_seq = 0
         self._seq_mu = threading.Lock()
+
+    def _ttl_expired(self, obj: Obj, now: float) -> bool:
+        if not self.ttl_seconds:
+            return False
+        stamp = meta.parse_rfc3339(obj.get("lastTimestamp")) \
+            or meta.parse_rfc3339(
+                (obj.get("metadata") or {}).get("creationTimestamp"))
+        return stamp is not None and now - stamp > self.ttl_seconds
+
+    def _ttl_delete(self, obj: Obj) -> None:
+        try:
+            gone = self.storage.delete(
+                self.key_for(meta.namespace(obj) or "", meta.name(obj)),
+                self.info.resource, meta.name(obj))
+        except errors.StatusError:
+            return  # a concurrent delete already settled it
+        if self.after_delete:
+            # a TTL sweep is still a delete: stores that install
+            # after_delete hooks (CRD unregister, ClusterIP release) must
+            # see it, or setting ttl_seconds on such a store would leak
+            self.after_delete(gone)
 
     # ------------------------------------------------------------------ #
     # keys
@@ -143,8 +173,12 @@ class Store:
         return out
 
     def get(self, namespace: str, name: str) -> Obj:
-        return self.storage.get(self.key_for(namespace, name),
-                                self.info.resource, name)
+        obj = self.storage.get(self.key_for(namespace, name),
+                               self.info.resource, name)
+        if self.ttl_seconds and self._ttl_expired(obj, time.time()):
+            self._ttl_delete(obj)
+            raise errors.new_not_found(self.info.resource, name)
+        return obj
 
     def list(self, namespace: str = "", label_selector: str = "",
              field_selector: str = "") -> Obj:
@@ -159,6 +193,18 @@ class Store:
             return True
 
         items, rv = self.storage.list(self.prefix_for(namespace), pred)
+        if self.ttl_seconds:
+            # lazy TTL sweep: the list that would have served an expired
+            # object deletes it instead (watchers see DELETED); bounded by
+            # the listing the caller already paid for
+            now = time.time()
+            live = []
+            for o in items:
+                if self._ttl_expired(o, now):
+                    self._ttl_delete(o)
+                else:
+                    live.append(o)
+            items = live
         return self.scheme.new_list(self.info, items, rv)
 
     # resources whose spec is immutable after create: the reference's

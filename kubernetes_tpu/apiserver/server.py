@@ -150,6 +150,18 @@ class APIServer:
         self._stores: Dict[Tuple[str, str], Store] = {}
         for info in self.scheme.resources():
             self._install(info)
+        # TTL-bounded events storage (ISSUE 10; kube-apiserver --event-ttl,
+        # default 1h): the decision-provenance pipeline writes a
+        # FailedScheduling Event per (pod, reason-fingerprint) backoff step
+        # — without a TTL the events namespace grows without bound. Pruned
+        # lazily at read time (registry.Store); KTPU_EVENT_TTL=0 disables.
+        try:
+            ttl = float(os.environ.get("KTPU_EVENT_TTL", "3600") or 0)
+        except ValueError:
+            ttl = 3600.0
+        ev_store = self._stores.get(("", "events"))
+        if ev_store is not None and ttl > 0:
+            ev_store.ttl_seconds = ttl
         # multi-version CRD conversion wiring: (group, plural) → entry
         # (apiextensions conversion/converter.go; see apiserver/crd.py)
         self.crd_conversions: Dict[Tuple[str, str], Any] = {}

@@ -25,6 +25,21 @@ _DEFAULT_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                     0.5, 1.0, 2.5, 5.0, 10.0)
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping (text exposition format
+    spec: backslash, double-quote and line-feed MUST be escaped — a tenant
+    name or pod key containing any of them would otherwise corrupt the
+    whole exposition for every scraper)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition format: backslash and
+    line-feed only (quotes are legal in HELP text)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class _Metric:
     def __init__(self, name: str, help_: str, label_names: Sequence[str]):
         self.name = name
@@ -35,10 +50,20 @@ class _Metric:
     def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
         return tuple(labels.get(n, "") for n in self.label_names)
 
+    def _header(self) -> List[str]:
+        """Conformant `# HELP` / `# TYPE` preamble (HELP skipped when the
+        help text is empty — the format allows absence, not a blank)."""
+        out = []
+        if self.help:
+            out.append(f"# HELP {self.name} {escape_help(self.help)}")
+        out.append(f"# TYPE {self.name} {self.TYPE}")
+        return out
+
     @staticmethod
     def _fmt_labels(names: Sequence[str], values: Sequence[str],
                     extra: str = "") -> str:
-        pairs = [f'{n}="{v}"' for n, v in zip(names, values)]
+        pairs = [f'{n}="{escape_label_value(v)}"'
+                 for n, v in zip(names, values)]
         if extra:
             pairs.append(extra)
         return "{" + ",".join(pairs) + "}" if pairs else ""
@@ -69,8 +94,7 @@ class Counter(_Metric):
 
     def expose(self) -> List[str]:
         with self._mu:
-            out = [f"# HELP {self.name} {self.help}",
-                   f"# TYPE {self.name} {self.TYPE}"]
+            out = self._header()
             for k, v in sorted(self._values.items()):
                 out.append(f"{self.name}"
                            f"{self._fmt_labels(self.label_names, k)} {v}")
@@ -144,8 +168,7 @@ class Histogram(_Metric):
 
     def expose(self) -> List[str]:
         with self._mu:
-            out = [f"# HELP {self.name} {self.help}",
-                   f"# TYPE {self.name} {self.TYPE}"]
+            out = self._header()
             for k in sorted(self._totals):
                 acc = 0
                 for i, b in enumerate(self.buckets):

@@ -56,7 +56,7 @@ def fleet_signature(K: int) -> int:
     return int(K)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 5, 9))
+@functools.partial(jax.jit, static_argnums=(3, 5, 9, 10))
 def _fleet_cycle_impl(
     tables,          # stacked ClusterTables [K, …]
     pending,         # stacked PodArrays [K, P]
@@ -68,6 +68,7 @@ def _fleet_cycle_impl(
     hard_weight=1.0,
     ecfg=None,
     rc: int = 0,
+    explain: bool = False,
 ):
     from ..ops.runs import assign_runs
     from ..ops.waves import assign_waves
@@ -84,29 +85,44 @@ def _fleet_cycle_impl(
             res = assign_runs(t, cyc, clamped, init, rc)
         else:
             res = assign_waves(t, cyc, clamped, init)
-        return res.node, res.feasible, admitted, share, dom
+        exp = None
+        if explain:
+            # ISSUE 10: fleet mode attributes PER TENANT inside the same
+            # vmap'd dispatch — the class-collapsed reduction per tenant
+            # row (quota-clamped pods carry valid=False and zero out; the
+            # commit loop requeues them before ever reading attribution)
+            from ..ops.assign import explain_assignments
 
-    node, feas, admitted, share, dom = jax.vmap(body)(
+            exp = explain_assignments(t, cyc, clamped, res,
+                                      granularity="class")
+        return res.node, res.feasible, admitted, share, dom, exp
+
+    node, feas, admitted, share, dom, exp = jax.vmap(body)(
         tables, pending, keys, existing, quota)
-    return FleetResult(node=node, feasible=feas, admitted=admitted,
-                       share=share, dom=dom)
+    res = FleetResult(node=node, feasible=feas, admitted=admitted,
+                      share=share, dom=dom)
+    return (res, exp) if explain else res
 
 
 def dispatch_fleet(tables, pending, keys, D, existing, engine, quota,
                    hard_weight: float = 1.0, ecfg=None, rc: int = 0,
-                   dims=None, prewarmer=None, mesh=None):
+                   dims=None, prewarmer=None, mesh=None,
+                   explain: bool = False):
     """The fleet analog of sched/cycle.py `_schedule_batch`: normalize the
     traced config scalars, probe the prewarmer for an AOT executable under
     the FLEET key (dims, engine, rc, fleet=K, mesh) — a single-cluster
     Compiled can never answer, the key slot forbids it — and fall through
-    to the ordinary jit."""
+    to the ordinary jit. With `explain` (ISSUE 10, KTPU_EXPLAIN) the
+    prewarmed executables are bypassed (they were compiled without the
+    attribution tail) and the result is (FleetResult, stacked [K, …]
+    ExplainResult)."""
     from ..ops.lattice import strong_engine_config
 
     K = int(quota.shape[0])
     ecfg = strong_engine_config(ecfg) if ecfg is not None \
         else default_engine_config()
     hw = jnp.float32(hard_weight)
-    if prewarmer is not None and dims is not None:
+    if prewarmer is not None and dims is not None and not explain:
         compiled = prewarmer.lookup(dims, engine, (), False, mesh=mesh,
                                     rc=rc, fleet=fleet_signature(K))
         if compiled is not None:
@@ -116,4 +132,4 @@ def dispatch_fleet(tables, pending, keys, D, existing, engine, quota,
             except TypeError:
                 pass  # aval/pytree drift — take the ordinary jit path
     return _fleet_cycle_impl(tables, pending, keys, D, existing, engine,
-                             quota, hw, ecfg, rc)
+                             quota, hw, ecfg, rc, explain)

@@ -521,6 +521,10 @@ class FleetServer:
 
         # ---- ONE vmap'd dispatch for the whole fleet ---- #
         stack = self.stack
+        # decision provenance (ISSUE 10): one flag for the whole stack —
+        # tenants share the process env, and the vmap'd program is one
+        # executable. Attribution fans back out per tenant in _commit_tick.
+        explain_on = any(t.sched.explainer is not None for t in tlist)
 
         def _primary():
             if stack.block is None:
@@ -532,11 +536,13 @@ class FleetServer:
                 # dropped buffers
                 stack.refresh([snaps[t.name] for t in tlist],
                               [keys[t.name] for t in tlist], d)
-            res = dispatch_fleet(stack.tables, stack.pending, stack.keys,
+            out = dispatch_fleet(stack.tables, stack.pending, stack.keys,
                                  d.D, stack.existing, engine, quota,
                                  rc=rc, dims=d, prewarmer=self.prewarmer,
-                                 mesh=self.mesh)
-            return jax.device_get(res)
+                                 mesh=self.mesh, explain=explain_on)
+            res, exp = out if explain_on else (out, None)
+            return jax.device_get(res), \
+                (jax.device_get(exp) if exp is not None else None)
 
         def _fallback(dev, hung=False):
             # degraded fleet tick: re-encode every tenant onto the CPU
@@ -562,8 +568,11 @@ class FleetServer:
             q = jax.device_put(jnp.asarray(self._pad_quota(tlist, Kp),
                                            jnp.float32), dev)
             with jax.default_device(dev):
-                res = dispatch_fleet(tb, pe, ky, d.D, ex, engine, q, rc=rc)
-                return jax.device_get(res)
+                out = dispatch_fleet(tb, pe, ky, d.D, ex, engine, q, rc=rc,
+                                     explain=explain_on)
+                res, exp = out if explain_on else (out, None)
+                return jax.device_get(res), \
+                    (jax.device_get(exp) if exp is not None else None)
 
         from ..parallel.mesh import mesh_key as _mesh_key
 
@@ -573,15 +582,16 @@ class FleetServer:
              _mesh_key(self.mesh), rc),
             _primary, _fallback)
         span.mark("dispatch")
-        out = handle.result()
+        out, exp = handle.result()
         span.mark("readback")
-        return out, snaps
+        return (out, exp), snaps
 
     def _commit_tick(self, out, tlist, batches, snaps, tick, now) -> None:
         """The per-tenant commit loops (PR 4 machinery per tenant): intent
         write → assume → fenced bind → retire, through each tenant's own
         Scheduler, plus the DRF violation check over the dispatch's own
         outputs."""
+        out, exp = out
         node = np.asarray(out.node)
         admitted = np.asarray(out.admitted)
         share = np.asarray(out.share)
@@ -599,6 +609,30 @@ class FleetServer:
             st = tick.per_tenant[t.name]
             order = snaps[t.name].node_order
             cycle = s.queue.current_cycle()
+            # per-TENANT decision provenance (ISSUE 10): slice tenant k's
+            # rows off the stacked attribution and feed ITS explainer —
+            # quota-clamped pods (admitted=False) are excluded: they carry
+            # no verdict this tick, and their zeroed attribution would
+            # render as empty-reason noise
+            if exp is not None and s.explainer is not None \
+                    and batches[t.name]:
+                idx = [i for i in range(len(batches[t.name]))
+                       if admitted[k, i]]
+                if idx:
+                    from ..ops.assign import ExplainResult
+
+                    sl = ExplainResult(*(np.asarray(a)[k][idx]
+                                         for a in exp))
+                    try:
+                        rec = s.explainer.observe_wave(
+                            [batches[t.name][i] for i in idx],
+                            node[k][idx], sl, order, now=now)
+                    except Exception:  # noqa: BLE001 - provenance must
+                        rec = None     # never take down a tick
+                    if rec:
+                        self.telemetry.note_supervisor_event(
+                            "explain", f"{t.name}: "
+                            f"{rec.get('unschedulable', 0)} attributed")
             commits: List[Tuple] = []
             failures: List[Tuple] = []
             for i, (pod, attempts) in enumerate(batches[t.name]):

@@ -98,6 +98,30 @@ def now_rfc3339() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
+def parse_rfc3339(s: Optional[str]) -> Optional[float]:
+    """Epoch seconds for an RFC3339 timestamp (now_rfc3339's Z form;
+    fractional seconds dropped; ±HH:MM offsets applied), or None when
+    absent/unparseable — the TTL sweep must treat a malformed stamp as
+    'no stamp', never raise."""
+    if not s or not isinstance(s, str):
+        return None
+    import calendar
+    import re
+
+    base = s[:19]  # YYYY-MM-DDTHH:MM:SS
+    try:
+        t = float(calendar.timegm(
+            time.strptime(base, "%Y-%m-%dT%H:%M:%S")))
+    except ValueError:
+        return None
+    m = re.match(r"^(?:\.\d+)?([+-])(\d{2}):?(\d{2})$", s[19:].rstrip("Z"))
+    if m:
+        sign, hh, mm = m.group(1), int(m.group(2)), int(m.group(3))
+        off = hh * 3600 + mm * 60
+        t += -off if sign == "+" else off
+    return t
+
+
 def gvk(obj: Obj) -> Tuple[str, str, str]:
     """(group, version, kind) from apiVersion/kind fields."""
     api_version = obj.get("apiVersion", "v1")

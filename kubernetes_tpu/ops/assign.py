@@ -175,6 +175,47 @@ def mask_context_row(
     return cyc.static.mask[cls] & interpod_ok & spread_ok & host_ok & valid
 
 
+def fit_plane(tables: ClusterTables, cyc: CycleArrays, cls: Array,
+              used: Array) -> Array:
+    """PodFitsResources plane [N] incl. the plugin flag — the ONE
+    composition shared by the engines' dynamic mask and the explain
+    attribution (drift between the two would make reason counts lie)."""
+    from .lattice import _on
+
+    req_vec = tables.reqs.vec[tables.classes.rid[cls]]
+    return fit_row(req_vec, used, tables.nodes.alloc, tables.nodes.valid) \
+        | ~_on(cyc.ecfg.f_fit)
+
+
+def ports_plane(tables: ClusterTables, cyc: CycleArrays, cls: Array,
+                ppa: Array, ppw: Array, ppt: Array) -> Array:
+    """PodFitsHostPorts plane [N] incl. the plugin flag (shared, see
+    fit_plane)."""
+    from .lattice import _on
+
+    ps = tables.classes.portset[cls]
+    psafe = jnp.maximum(ps, 0)
+    conflict = port_conflict_row(
+        tables.portsets.wild_words[psafe],
+        tables.portsets.pair_words[psafe],
+        tables.portsets.trip_words[psafe],
+        ppa, ppw, ppt,
+    )
+    return (ps < 0) | ~conflict | ~_on(cyc.ecfg.f_ports)
+
+
+def volumes_plane(tables: ClusterTables, cyc: CycleArrays, cls: Array,
+                  vol_any: Array, vol_rw: Array) -> Array:
+    """NoDiskConflict + volume-limits plane [N] incl. the plugin flags
+    (shared, see fit_plane)."""
+    from .lattice import _on
+
+    vconf_free, vlimit_ok = volume_components_row(
+        tables, vol_any, vol_rw, cls)
+    return (vconf_free | ~_on(cyc.ecfg.f_volrestrict)) \
+        & (vlimit_ok | ~_on(cyc.ecfg.f_vollimits))
+
+
 def mask_dynamic_row(
     tables: ClusterTables,
     cyc: CycleArrays,
@@ -187,29 +228,11 @@ def mask_dynamic_row(
     resources, host ports, volumes — all strictly per-node functions of the
     passed state planes. The run-collapsed engine re-evaluates exactly this
     per admission epoch against synthesized per-node planes; the per-pod
-    scan calls it (via pod_mask_row) with the live carry."""
-    from .lattice import _on
-
-    nodes, classes = tables.nodes, tables.classes
-    ecfg = cyc.ecfg
-    rid = classes.rid[cls]
-    req_vec = tables.reqs.vec[rid]
-    fit = fit_row(req_vec, used, nodes.alloc, nodes.valid) \
-        | ~_on(ecfg.f_fit)
-    ps = classes.portset[cls]
-    psafe = jnp.maximum(ps, 0)
-    conflict = port_conflict_row(
-        tables.portsets.wild_words[psafe],
-        tables.portsets.pair_words[psafe],
-        tables.portsets.trip_words[psafe],
-        ppa, ppw, ppt,
-    )
-    port_ok = (ps < 0) | ~conflict | ~_on(ecfg.f_ports)
-    vconf_free, vlimit_ok = volume_components_row(
-        tables, vol_any, vol_rw, cls)
-    vol_ok = (vconf_free | ~_on(ecfg.f_volrestrict)) \
-        & (vlimit_ok | ~_on(ecfg.f_vollimits))
-    return fit & port_ok & vol_ok
+    scan calls it (via pod_mask_row) with the live carry. Composed from the
+    same per-plane helpers the explain attribution decomposes."""
+    return (fit_plane(tables, cyc, cls, used)
+            & ports_plane(tables, cyc, cls, ppa, ppw, ppt)
+            & volumes_plane(tables, cyc, cls, vol_any, vol_rw))
 
 
 def pod_mask_row(
@@ -366,6 +389,285 @@ def mask_components(
 
     parts = jax.vmap(row)(pods.cls, pods.node_name_req, pods.valid)
     return MaskComponents(*parts)
+
+
+# --------------------------------------------------------------------------- #
+# decision provenance (ISSUE 10): per-pod unschedulability attribution and
+# winning-score decomposition as cheap sum-reductions over the SAME mask/score
+# expression trees the engines evaluate — computed inside the wave dispatch
+# when KTPU_EXPLAIN is on, byte-for-byte absent otherwise (a static jit flag).
+# --------------------------------------------------------------------------- #
+
+#: predicate order of ExplainResult.reasons — kube PredicateFailureReason
+#: names rendered by sched/explain.py (algorithm/predicates/error.go)
+EXPLAIN_PREDICATES = ("node_match", "taints", "fit", "ports", "affinity",
+                      "anti", "spread", "host", "volumes")
+#: score-component order of ExplainResult.score_parts (prioritizeNodes'
+#: weighted sum, decomposed)
+EXPLAIN_SCORE_COMPONENTS = ("static", "least", "balanced", "most",
+                            "interpod", "even", "ssel")
+#: candidate nodes reported per pod (clamped to N at trace time)
+EXPLAIN_TOPK = 3
+
+
+class ExplainResult(NamedTuple):
+    """Per-pod decision attribution for one wave, evaluated against the
+    POST-wave assume state (result.state): the "why is this pod still
+    pending NOW" answer, not a replay of each scan step. All counts are
+    over VALID nodes; invalid (padding) pods zero out."""
+
+    reasons: Array         # [P, 9] i32 — nodes rejected per predicate
+    valid_nodes: Array     # [P] i32 — denominator ("0/N nodes are available")
+    feasible_nodes: Array  # [P] i32 — nodes passing EVERY predicate
+    rejected_any: Array    # [P] i32 — valid_nodes - feasible_nodes
+    top_nodes: Array       # [P, K] i32 — best feasible nodes by score (-1 pad)
+    top_scores: Array      # [P, K] f32
+    score_parts: Array     # [P, 7] f32 — component breakdown at part_node
+    part_node: Array       # [P] i32 — chosen node if scheduled, else best
+    #                        feasible node, else -1
+
+
+def _explain_mask_row(tables: ClusterTables, cyc: CycleArrays,
+                      state: AssignState, c: Array):
+    """The cheap half of attribution for ONE class against `state`: the 8
+    class-granular predicate planes reduced to rejected-node counts
+    (host/spec.nodeName is per-pod and folded by the caller) plus the
+    full-mask [N] row. Every plane honors its EngineConfig plugin flag
+    exactly as pod_mask_row/mask_dynamic_row compose it — a disabled
+    plugin never rejects, so counts reconcile with the engine's own
+    verdicts. This half runs on EVERY explain-on wave (sub-ms at bench
+    shapes)."""
+    from .lattice import _on
+
+    nodes, classes, terms = tables.nodes, tables.classes, tables.terms
+    ecfg = cyc.ecfg
+    D = cyc.ELD.shape[2] - 1
+    nm = cyc.static.node_match[c]
+    # static.mask = node_match ∧ taint_ok ∧ unsched_pass ∧ class-valid;
+    # recover the taint/unschedulable plane by division (mask_components)
+    taints_ok = cyc.static.mask[c] | ~nm
+    # dynamic planes through the SAME helpers mask_dynamic_row conjoins —
+    # the engines' verdicts and these counts cannot drift apart
+    fit = fit_plane(tables, cyc, c, state.used)
+    ports_ok = ports_plane(tables, cyc, c, state.ppa, state.ppw, state.ppt)
+    vol_ok = volumes_plane(tables, cyc, c, state.vol_any, state.vol_rw)
+    # interpod/spread decomposed: mask_context_row conjoins (aff ∧ anti)
+    # under one flag — KEEP the flag composition in sync with it
+    aff_ok, anti_ok = affinity_rows(
+        c, classes, terms, cyc.TM, state.CNT, state.HOLD, nodes, D)
+    aff_ok = aff_ok | ~_on(ecfg.f_interpod)
+    anti_ok = anti_ok | ~_on(ecfg.f_interpod)
+    spread_ok = spread_row(
+        c, classes, terms, cyc.TM, state.CNT, cyc.ELD,
+        cyc.static.node_match[c], nodes, D,
+    ) | ~_on(ecfg.f_spread)
+    planes = jnp.stack([nm, taints_ok, fit, ports_ok, aff_ok, anti_ok,
+                        spread_ok, vol_ok])            # [8, N]
+    nv = nodes.valid
+    reasons8 = jnp.sum(nv[None, :] & ~planes, axis=1).astype(jnp.int32)
+    mask8 = planes.all(axis=0) & nv
+    return reasons8, mask8
+
+
+def _explain_score_row(tables: ClusterTables, cyc: CycleArrays,
+                       state: AssignState, c: Array):
+    """The EXPENSIVE half for one class: the composed score row and the
+    context score components (soft inter-pod affinity's min/max
+    normalization, even-spread, selector-spread — one extra full score
+    pass per class, ~an engine wave-iteration's worth of work). Only
+    evaluated under the failure-gated branch of explain_assignments."""
+    ctxs = score_context_row(tables, cyc, state, c)
+    ctx = jnp.stack([ctxs.soft_ip, ctxs.even_soft, ctxs.ssel])  # [3, N]
+    score = score_combine_row(tables, cyc, c, state.used, ctxs)
+    return score, ctx
+
+
+def _row_topk(masked, K: int):
+    """Top-K (node index, score) of one masked score row — K iterative
+    argmax passes with where-iota elimination, NOT lax.top_k: top_k sorts
+    the whole row (N log N per row — measured as the bulk of the
+    attribution overhead at bench shapes) while K=3 linear maxes keep the
+    engines' own argmax tie-break (lowest index wins). Dead slots (score
+    -inf: fewer than K feasible nodes) report node -1 / score 0."""
+    iota = jnp.arange(masked.shape[0], dtype=jnp.int32)
+    tops_l, topi_l = [], []
+    cur = masked
+    for _ in range(K):
+        i = jnp.argmax(cur).astype(jnp.int32)
+        tops_l.append(cur[i])
+        topi_l.append(i)
+        cur = jnp.where(iota == i, -jnp.inf, cur)
+    tops = jnp.stack(tops_l)
+    topi = jnp.stack(topi_l)
+    live = tops > -jnp.inf
+    return jnp.where(live, topi, -1), jnp.where(live, tops, 0.0)
+
+
+def explain_assignments(
+    tables: ClusterTables, cyc: CycleArrays, pods: PodArrays,
+    result: AssignResult, granularity: str = "class",
+) -> ExplainResult:
+    """The attribution reduction for one wave, against result.state (the
+    post-wave assume state). Two granularities, bit-equal by shared code:
+
+      * "pod"   — the spec: one full row per pod (the scan engine's
+                  granularity; cost scales with P·N).
+      * "class" — the cheap half evaluates ONCE per interned class (the
+                  run-collapsed engine's fan-out; the waves engine shares
+                  it — both already think in [SC, N] planes), then per-pod
+                  work is pure GATHERS when no spec.nodeName pod is in the
+                  batch (a lax.cond keeps the per-pod host fold for
+                  batches that actually pin).
+
+    Cost discipline (the <=2% bench budget): the REASON/feasibility
+    reductions (the mask planes) always run — they are sum-reductions
+    over planes the lattice already materializes, sub-ms. The score
+    DECOMPOSITION — candidate ranking and per-component parts, which
+    needs one extra full score-context pass per class (an engine
+    wave-iteration's worth of work) — runs under a failure-gated
+    lax.cond: a wave with nothing to explain (every pod placed) skips
+    it, reporting empty candidates and zeroed parts; any wave carrying
+    an unschedulable pod pays the full cost, proportional to need.
+
+    Both granularities share `_explain_mask_row`/`_explain_score_row`/
+    `_row_topk`/the parts stage, so the outputs are bit-equal — asserted
+    by tests/test_explain.py."""
+    from .lattice import _on
+
+    state = result.state
+    chosen = result.node
+    nodes = tables.nodes
+    nv = nodes.valid
+    SC = tables.classes.valid.shape[0]
+    P = pods.valid.shape[0]
+    K = min(EXPLAIN_TOPK, int(nv.shape[0]))
+    cls_safe = jnp.clip(pods.cls, 0, SC - 1)
+    validn_scalar = jnp.sum(nv).astype(jnp.int32)
+    i32 = jnp.int32
+    any_failed = ((chosen < 0) & pods.valid).any()
+
+    def host_plane(nnr):
+        return (nnr < 0) | (nodes.name_id == nnr) | ~_on(cyc.ecfg.f_name)
+
+    def parts_stage(pn, ctx_at):
+        """Score decomposition at the explained node: [P]-sized gathers +
+        pointwise resource scores (shared by both granularities)."""
+        w = cyc.ecfg
+        j = jnp.maximum(pn, 0)
+        req = tables.reqs.vec[tables.classes.rid[cls_safe]]  # [P, R]
+        least, balanced, most = jax.vmap(resource_scores_row)(
+            req, state.used[j][:, None, :], nodes.alloc[j][:, None, :])
+        parts = jnp.stack([
+            cyc.static.score[cls_safe, j],
+            least[:, 0] * w.w_least, balanced[:, 0] * w.w_balanced,
+            most[:, 0] * w.w_most,
+            ctx_at[:, 0] * w.w_interpod, ctx_at[:, 1] * w.w_even,
+            ctx_at[:, 2] * w.w_ssel,
+        ], axis=1)                                           # [P, 7]
+        return jnp.where((pn >= 0)[:, None], parts, 0.0)
+
+    def cheap_score(_):
+        # failure-free wave: nothing to rank or decompose
+        return (jnp.full((P, K), -1, i32), jnp.zeros((P, K), jnp.float32),
+                jnp.zeros((P, len(EXPLAIN_SCORE_COMPONENTS)), jnp.float32),
+                jnp.where(chosen >= 0, chosen, -1))
+
+    if granularity == "pod":
+        def mrow(c, nnr):
+            r8, m8 = _explain_mask_row(tables, cyc, state, c)
+            host_ok = host_plane(nnr)
+            host_rej = jnp.sum(nv & ~host_ok).astype(i32)
+            reasons = jnp.concatenate([r8[:7], host_rej[None], r8[7:]])
+            feas = jnp.sum(m8 & host_ok).astype(i32)
+            return reasons, feas
+
+        reasons, feas = jax.vmap(mrow)(cls_safe, pods.node_name_req)
+
+        def pod_score(_):
+            def row(c, nnr, ch):
+                _r8, m8 = _explain_mask_row(tables, cyc, state, c)
+                full = m8 & host_plane(nnr)
+                sc_row, cx = _explain_score_row(tables, cyc, state, c)
+                topn, tops = _row_topk(
+                    jnp.where(full, sc_row, -jnp.inf), K)
+                pn = jnp.where(ch >= 0, ch, topn[0])
+                ctx_at = cx[:, jnp.maximum(pn, 0)]
+                return topn, tops, pn, ctx_at
+
+            topn, tops, pn, ctx_at = jax.vmap(row)(
+                cls_safe, pods.node_name_req, chosen)
+            return topn, tops, parts_stage(pn, ctx_at), pn
+
+        topn, tops, parts, pn = jax.lax.cond(
+            any_failed, pod_score, cheap_score, None)
+    else:
+        r8, m8 = jax.vmap(
+            lambda c: _explain_mask_row(tables, cyc, state, c)
+        )(jnp.arange(SC, dtype=jnp.int32))
+        reasons9_c = jnp.concatenate(
+            [r8[:, :7], jnp.zeros((SC, 1), i32), r8[:, 7:]], axis=1)
+        feas_c = m8.sum(axis=1).astype(i32)
+        any_pinned = ((pods.node_name_req >= 0) & pods.valid).any()
+
+        def gather_mask(_):
+            # no pinned pods: the host plane is all-true for every pod, so
+            # the class-level reductions ARE the per-pod answers
+            return reasons9_c[cls_safe], feas_c[cls_safe]
+
+        def host_mask(_):
+            def fin(c, nnr):
+                host_ok = host_plane(nnr)
+                host_rej = jnp.sum(nv & ~host_ok).astype(i32)
+                reasons = jnp.concatenate(
+                    [r8[c, :7], host_rej[None], r8[c, 7:]])
+                feas = jnp.sum(m8[c] & host_ok).astype(i32)
+                return reasons, feas
+
+            return jax.vmap(fin)(cls_safe, pods.node_name_req)
+
+        reasons, feas = jax.lax.cond(any_pinned, host_mask, gather_mask,
+                                     None)
+
+        def class_score(_):
+            sc_rows, cx = jax.vmap(
+                lambda c: _explain_score_row(tables, cyc, state, c)
+            )(jnp.arange(SC, dtype=jnp.int32))
+            masked_c = jnp.where(m8, sc_rows, -jnp.inf)
+            topn_c, tops_c = jax.vmap(
+                lambda row: _row_topk(row, K))(masked_c)
+
+            def g(_):
+                return topn_c[cls_safe], tops_c[cls_safe]
+
+            def h(_):
+                def fin(c, nnr):
+                    full = m8[c] & host_plane(nnr)
+                    return _row_topk(
+                        jnp.where(full, sc_rows[c], -jnp.inf), K)
+
+                return jax.vmap(fin)(cls_safe, pods.node_name_req)
+
+            topn, tops = jax.lax.cond(any_pinned, h, g, None)
+            pn = jnp.where(chosen >= 0, chosen, topn[:, 0])
+            ctx_at = cx[cls_safe, :, jnp.maximum(pn, 0)]
+            return topn, tops, parts_stage(pn, ctx_at), pn
+
+        topn, tops, parts, pn = jax.lax.cond(
+            any_failed, class_score, cheap_score, None)
+
+    # invalid (padding) pods zero out across the board
+    v = pods.valid
+    vi = v.astype(i32)
+    return ExplainResult(
+        reasons=reasons * vi[:, None],
+        valid_nodes=validn_scalar * vi,
+        feasible_nodes=feas * vi,
+        rejected_any=(validn_scalar - feas) * vi,
+        top_nodes=jnp.where(v[:, None], topn, -1),
+        top_scores=tops * v[:, None].astype(jnp.float32),
+        score_parts=parts * v[:, None].astype(jnp.float32),
+        part_node=jnp.where(v, pn, -1),
+    )
 
 
 def score_matrix(

@@ -96,6 +96,11 @@ class KubeSchedulerConfiguration:
     # admission window (ops/lattice.py EngineConfig.w_window, PARITY #3).
     # Default MaxNodeScore=100; 0 = strict per-wave argmax tiers.
     score_admission_window: float = 100.0
+    # TPU-specific extension (ISSUE 10): decision provenance — the
+    # on-device unschedulability attribution + FailedScheduling event
+    # pipeline (sched/explain.py). Off by default; KTPU_EXPLAIN env is
+    # the other switch.
+    decision_provenance: bool = False
     bind_timeout_seconds: float = 600.0    # :91
     pod_initial_backoff_seconds: float = 1.0   # :96
     pod_max_backoff_seconds: float = 10.0      # :101
@@ -248,6 +253,7 @@ def load_config(source) -> KubeSchedulerConfiguration:
         score_admission_window=(
             lambda v: v if v == v and v >= 0 else 100.0)(
                 float(data.get("scoreAdmissionWindow", 100.0))),
+        decision_provenance=bool(data.get("decisionProvenance", False)),
         bind_timeout_seconds=float(data.get("bindTimeoutSeconds", 600)),
         pod_initial_backoff_seconds=float(
             data.get("podInitialBackoffSeconds", 1)),

@@ -113,7 +113,7 @@ def _apply_extra_plugins(tables, cyc, extra_plugins, extra_weights):
         score=cyc.static.score + bias))
 
 
-@functools.partial(jax.jit, static_argnums=(3, 5, 8, 11, 12))
+@functools.partial(jax.jit, static_argnums=(3, 5, 8, 11, 12, 13))
 def _schedule_batch_impl(
     tables: ClusterTables,
     pending: PodArrays,
@@ -128,6 +128,7 @@ def _schedule_batch_impl(
     gang=None,
     return_waves: bool = False,
     rc: int = 0,
+    explain: bool = False,
 ):
     from ..ops.gang import assign_gang
     from ..ops.runs import assign_runs
@@ -141,28 +142,42 @@ def _schedule_batch_impl(
     # plan_runs); it also bounds every gang rejection round's run count
     # (masking merges/shrinks runs, never splits them)
     runs_fn = (lambda t, cy, pe, ini: assign_runs(t, cy, pe, ini, rc))
+    waves = None
     if gang is not None:
         # group-atomic admission (ops/gang.py); gang=None traces the plain
         # engines, so gang-free batches compile/run exactly as before
         if return_waves and engine == "waves":
             res, _, waves = assign_gang(tables, cyc, pending, init, gang,
                                         return_waves=True)
-            return res, waves
-        engine_fn = {"scan": assign_batch, "runs": runs_fn}.get(engine)
-        res, _ = assign_gang(
-            tables, cyc, pending, init, gang, engine_fn=engine_fn)
-        return (res, None) if return_waves else res
-    if engine == "scan":
+        else:
+            engine_fn = {"scan": assign_batch, "runs": runs_fn}.get(engine)
+            res, _ = assign_gang(
+                tables, cyc, pending, init, gang, engine_fn=engine_fn)
+    elif engine == "scan":
         res = assign_batch(tables, cyc, pending, init)
-        return (res, None) if return_waves else res
-    if engine == "runs":
+    elif engine == "runs":
         res = runs_fn(tables, cyc, pending, init)
-        return (res, None) if return_waves else res
-    if return_waves:
+    elif return_waves:
         # bench/profiling: per-pod admission-wave indices ride along so the
         # driver can report wave counts without a second dispatch
-        return assign_waves(tables, cyc, pending, init, return_waves=True)
-    return assign_waves(tables, cyc, pending, init)
+        res, waves = assign_waves(tables, cyc, pending, init,
+                                  return_waves=True)
+    else:
+        res = assign_waves(tables, cyc, pending, init)
+    if explain:
+        # decision provenance (ISSUE 10): the attribution reduction runs
+        # INSIDE this same dispatch, against the post-wave assume state.
+        # The scan engine attributes per pod (the spec); the class-interned
+        # engines attribute once per equivalence class and fan out — the
+        # runs engine's collapse applied to observability. A static flag:
+        # explain=False traces the byte-for-byte pre-provenance program.
+        from ..ops.assign import explain_assignments
+
+        exp = explain_assignments(
+            tables, cyc, pending, res,
+            granularity="pod" if engine == "scan" else "class")
+        return res, exp
+    return (res, waves) if return_waves else res
 
 
 @functools.partial(jax.jit, static_argnums=(2, 6))
@@ -274,13 +289,24 @@ def _schedule_batch(tables, pending, keys, D, existing,
                     dims=None,
                     prewarmer=None,
                     mesh=None,
-                    runs=None):
+                    runs=None,
+                    explain: bool = False):
+    # the two opt-in result tails are mutually exclusive by contract:
+    # return_waves callers unpack (res, waves) and would silently read an
+    # ExplainResult as the wave-index array
+    assert not (explain and return_waves), \
+        "explain and return_waves cannot be combined"
     engine = _engine()
     if gang is not None and engine == "waves" and not has_node_name \
             and pending.valid.shape[0] >= _GANG_HOST_THRESHOLD:
         out = _schedule_gang_host_rounds(
             tables, pending, keys, D, existing, hard_weight, ecfg,
             extra_plugins, extra_weights, gang)
+        if explain:
+            # the host-rounds gang path re-dispatches per rejection round;
+            # attribution is not folded into it (observability never costs
+            # the giant-gang path extra dispatches) — callers get None
+            return out[0], None
         return out if return_waves else out[0]
     if engine == "waves" and has_node_name:
         # spec.nodeName pods carry a per-POD (not per-class) host constraint
@@ -301,7 +327,12 @@ def _schedule_batch(tables, pending, keys, D, existing,
     ecfg = strong_engine_config(ecfg) if ecfg is not None \
         else default_engine_config()
     hw = jnp.float32(hard_weight)
-    if prewarmer is not None and dims is not None and not return_waves:
+    # explain bypasses the prewarmed executables: they were AOT-compiled
+    # without the attribution tail, and a separate explain-keyed compile
+    # set would double the prewarm budget for an opt-in debug surface —
+    # the module-level jit cache keeps explain-on steady state warm instead
+    if prewarmer is not None and dims is not None and not return_waves \
+            and not explain:
         # prewarmed executable for this exact signature: calling the stored
         # jax Compiled skips trace+lower+compile — the boundary cycle right
         # after a capacity-bucket crossing stays in budget (sched/prewarm.py).
@@ -322,7 +353,7 @@ def _schedule_batch(tables, pending, keys, D, existing,
     return _schedule_batch_impl(tables, pending, keys, D, existing, engine,
                                 hw, ecfg,
                                 extra_plugins, extra_weights, gang,
-                                return_waves, rc)
+                                return_waves, rc, explain)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))

@@ -111,6 +111,30 @@ SHED_PODS = REG.counter(
     "Low-priority pods parked in the deferred lane by the governor "
     "(deferred, never dropped — they re-admit when shedding ends)",
     labels=("governor",))
+# ISSUE 10 decision provenance (sched/explain.py): per-predicate rejection
+# attribution for unschedulable pods and the winning node's score-component
+# decomposition for scheduled ones — the on-device reduction's metric sinks.
+UNSCHEDULABLE_REASONS = REG.counter(
+    "scheduler_unschedulable_reasons_total",
+    "Rejected-node attributions for unschedulable pods, by predicate "
+    "(one increment per rejected node per unschedulable pod-wave — the "
+    "tensor analog of FailedScheduling reason counts)",
+    labels=("predicate",))
+SCORE_SHARE = REG.counter(
+    "scheduler_scheduled_score_share",
+    "Accumulated score-component contribution at the winning node of every "
+    "scheduled pod (a component's share = its value / the sum across "
+    "components) — the explainability signal the learned-scoring roadmap "
+    "items train against",
+    labels=("component",))
+FAILED_EVENTS = REG.counter(
+    "scheduler_failed_scheduling_events_total",
+    "FailedScheduling event dispositions from the decision-provenance "
+    "pipeline: emitted (written through the apiserver), deduped (suppressed "
+    "by the per-(pod, fingerprint) exponential backoff), capped (deferred "
+    "by the per-wave write budget; re-qualifies next occurrence), error "
+    "(write failed past the retry budget), unsinked (no sink attached)",
+    labels=("outcome",))
 
 
 def observe_fleet_tick(per_tenant) -> None:

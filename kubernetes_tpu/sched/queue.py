@@ -310,6 +310,21 @@ class PriorityQueue:
                  or self._deferred.get(key))
             return e.pod if e is not None else None
 
+    def describe(self, key: str) -> Tuple[Optional[str], int]:
+        """(lane name, attempts) for `key` — the /debug/why surface's queue
+        half (sched/explain.py). Lane is one of "active"/"backoff"/
+        "unschedulable"/"deferred", or None when the pod is in no lane
+        (bound, deleted, or never seen)."""
+        with self._mu:
+            for lane, m in (("active", self._active_keys),
+                            ("backoff", self._backoff_keys),
+                            ("unschedulable", self._unschedulable),
+                            ("deferred", self._deferred)):
+                e = m.get(key)
+                if e is not None:
+                    return lane, e.attempts
+            return None, 0
+
     def lanes(self, key: str) -> Tuple[bool, bool, bool]:
         """(in activeQ, in backoffQ, in unschedulableQ) membership — the
         dedupe introspection the crash-requeue tests assert with (a pod must

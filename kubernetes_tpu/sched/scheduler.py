@@ -221,6 +221,31 @@ class Scheduler:
             batch_size, clock=self.clock,
             event_sink=self.telemetry.note_supervisor_event,
             name=scheduler_name)
+        # decision provenance (sched/explain.py, ISSUE 10): when
+        # KTPU_EXPLAIN is on (or the config's decisionProvenance flag —
+        # enable_explain()), every wave's dispatch also runs the on-device
+        # attribution reduction and this explainer renders it into events/
+        # metrics/the flight-recorder record/the /debug/why surface. None
+        # (the default) keeps the dispatch the byte-for-byte
+        # pre-provenance program — the KTPU_OVERLOAD kill-switch
+        # discipline.
+        from .explain import build_explainer
+
+        self.explainer = build_explainer(name=scheduler_name,
+                                         clock=self.clock)
+
+    def enable_explain(self, sink=None) -> None:
+        """Force decision provenance on for this scheduler (the
+        KubeSchedulerConfiguration `decisionProvenance: true` path —
+        per-process, no env)."""
+        if self.explainer is None:
+            from .explain import build_explainer
+
+            self.explainer = build_explainer(
+                name=self.scheduler_name, clock=self.clock, enabled=True,
+                sink=sink)
+        elif sink is not None and self.explainer.sink is None:
+            self.explainer.sink = sink
 
     @staticmethod
     def _make_mesh_state(mesh):
@@ -477,21 +502,37 @@ class Scheduler:
             snap.dims, wave_engine, extras, gang_arg is not None, rc=rc)
         span.mark("prewarm")
 
+        explain_on = self.explainer is not None
+
+        def _get_exp(exp_dev):
+            # attribution readback must never take down a wave: a zombie
+            # worker's arrays may live on a dead backend
+            if exp_dev is None:
+                return None
+            try:
+                return jax.device_get(exp_dev)
+            except Exception:  # noqa: BLE001 - observability, not placement
+                return None
+
         def _dispatch():
-            res = _schedule_batch(
+            out = _schedule_batch(
                 snap.tables, snap.pending, keys, snap.dims.D, snap.existing,
                 has_node_name=snap.dims.has_node_name,
                 hard_weight=self.hard_pod_affinity_weight,
                 ecfg=self.engine_config,
                 extra_plugins=extras, extra_weights=extra_w,
                 gang=gang_arg, dims=snap.dims, prewarmer=self.prewarmer,
-                mesh=snap.mesh, runs=snap.runs)
-            return res.node
+                mesh=snap.mesh, runs=snap.runs, explain=explain_on)
+            if explain_on:
+                res, exp = out
+                return res.node, exp
+            return out.node, None
 
         def _primary():
             tel = self.telemetry
             if not tel.enabled:
-                return jax.device_get(_dispatch())
+                node, exp = _dispatch()
+                return jax.device_get(node), _get_exp(exp)
             # tier-3 device-time split (runs on the watchdog worker):
             # launch (trace + async enqueue) vs XLA execution
             # (block_until_ready) vs host readback (device_get) — the
@@ -500,14 +541,15 @@ class Scheduler:
             # TraceAnnotation inside a lazily-started profiler trace.
             with tel.device_annotation("ktpu-wave-dispatch"):
                 tp0 = time.perf_counter()
-                node = _dispatch()
+                node, exp = _dispatch()
                 tp1 = time.perf_counter()
                 jax.block_until_ready(node)
                 tp2 = time.perf_counter()
                 out = jax.device_get(node)
+                exp_h = _get_exp(exp)
             tel.note_device_split(tp1 - tp0, tp2 - tp1,
                                   time.perf_counter() - tp2, token=span)
-            return out
+            return out, exp_h
 
         # the commit loop must map node indices through the node_order of
         # the snapshot that was ACTUALLY dispatched: a fallback re-encode
@@ -546,14 +588,20 @@ class Scheduler:
                 rn = fsnap.runs
                 wave_ctx["node_order"] = fsnap.node_order
             with jax.default_device(dev):
-                res = _schedule_batch(
+                out = _schedule_batch(
                     tb, pe, ky, dd.D, ex,
                     has_node_name=dd.has_node_name,
                     hard_weight=self.hard_pod_affinity_weight,
                     ecfg=self.engine_config,
                     extra_plugins=extras, extra_weights=extra_w,
-                    gang=gg, runs=rn)
-                return jax.device_get(res.node)
+                    gang=gg, runs=rn, explain=explain_on)
+                if explain_on:
+                    res, exp = out
+                    # degraded waves stay explainable: the chaos drill
+                    # reconstructs a degraded wave's failures from the
+                    # flight recorder, so the fallback attributes too
+                    return jax.device_get(res.node), _get_exp(exp)
+                return jax.device_get(out.node), None
 
         # the budget key carries the PROGRAM signature, not just the shape:
         # a gang-bearing or scan-routed wave at a warm shape traces a new
@@ -612,7 +660,7 @@ class Scheduler:
 
             span.mark("dispatch")
             try:
-                node_idx = handle.result()
+                node_idx, wave_exp = handle.result()
                 span.mark("readback")
             except DispatchAbandonedError:
                 span.mark("readback")
@@ -647,6 +695,16 @@ class Scheduler:
         failures: List[Tuple[Pod, int]] = []
         commits: List[Tuple[Pod, str, int]] = []
         wave_order = wave_ctx["node_order"]  # set by a fallback re-encode
+        # ---- decision provenance: render the attribution that rode the
+        # dispatch (events/metrics/latest-attribution inside observe_wave;
+        # the returned dict rides this wave's flight-recorder record) ---- #
+        explain_rec = None
+        if self.explainer is not None and wave_exp is not None:
+            try:
+                explain_rec = self.explainer.observe_wave(
+                    batch, node_idx, wave_exp, wave_order, now=now)
+            except Exception:  # noqa: BLE001 - provenance must never
+                explain_rec = None  # take down a wave
         for i, (pod, attempts) in enumerate(batch):
             ni = int(node_idx[i])
             if ni < 0:
@@ -732,8 +790,9 @@ class Scheduler:
         if self.governor is not None:
             self.governor.end_wave(now, stats.attempted,
                                    stats.cycle_seconds)
-        self.telemetry.finish_wave(span, stats=stats, engine=wave_engine,
-                                   dims=snap.dims, rc=rc)
+        self.telemetry.finish_wave(
+            span, stats=stats, engine=wave_engine, dims=snap.dims, rc=rc,
+            extra={"explain": explain_rec} if explain_rec else None)
         return stats
 
     def _schedule_one_with_extenders(

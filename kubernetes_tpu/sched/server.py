@@ -122,11 +122,17 @@ class TelemetryGateway:
                              (read-only: the same document shape an
                              auto-dump writes, with none of the dump
                              side effects)
+      /debug/why/<ns>/<pod>  the pod's latest decision attribution
+                             (ISSUE 10: reason counts, top-k candidates
+                             with score decomposition, queue lane +
+                             attempts + first-seen age) — requires a
+                             `scheduler` and its KTPU_EXPLAIN explainer
       /healthz               "ok"
 
     on a daemonized stdlib HTTP server; port 0 binds an ephemeral port."""
 
-    def __init__(self, telemetry, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, telemetry, host: str = "127.0.0.1", port: int = 0,
+                 scheduler=None):
         import http.server
         import json as _json
         import socketserver
@@ -134,6 +140,33 @@ class TelemetryGateway:
         from kubernetes_tpu.component.metrics import DEFAULT_REGISTRY
 
         tel = telemetry
+        sched = scheduler
+
+        def _why_doc(ns: str, name: str):
+            """The why-pending document, assembled read-only from the
+            explainer's latest attribution, the queue lane and the e2e
+            tracker's first-seen stamp. None when the pod is entirely
+            unknown (404)."""
+            key = f"{ns}/{name}"
+            doc: Dict[str, Any] = {"pod": key}
+            attribution = None
+            if getattr(sched, "explainer", None) is not None:
+                attribution = sched.explainer.why(key)
+                doc["explain_enabled"] = True
+            else:
+                doc["explain_enabled"] = False
+            lane, attempts = sched.queue.describe(key)
+            doc["queue_lane"] = lane
+            doc["attempts"] = attempts
+            first = tel.tracker.first_seen(key)
+            doc["first_seen_age_s"] = (
+                round(sched.clock() - first, 6) if first is not None
+                else None)
+            if attribution is not None:
+                doc["attribution"] = attribution
+            if attribution is None and lane is None and first is None:
+                return None
+            return doc
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, *a):  # noqa: ARG002 - silence stdlib
@@ -149,6 +182,17 @@ class TelemetryGateway:
                     # count as a dump, or write KTPU_FLIGHT_DIR files
                     body = _json.dumps(
                         tel.snapshot_doc("debug-endpoint"), indent=1).encode()
+                    ctype = "application/json"
+                elif path.startswith("/debug/why/") and sched is not None:
+                    parts = [p for p in path.split("/") if p][2:]
+                    if len(parts) != 2:
+                        self.send_error(404)
+                        return
+                    doc = _why_doc(parts[0], parts[1])
+                    if doc is None:
+                        self.send_error(404)
+                        return
+                    body = _json.dumps(doc, indent=1).encode()
                     ctype = "application/json"
                 elif path == "/healthz":
                     body, ctype = b"ok", "text/plain"
@@ -267,6 +311,11 @@ class SchedulerServer:
         if self.scheduler.binder is None:
             self.scheduler.binder = APIBinder(client)
         if self.config is not None:
+            if self.config.decision_provenance:
+                # config-file switch for the provenance pipeline (the env
+                # alternative is KTPU_EXPLAIN); the event sink attaches in
+                # start() with the informer lister
+                self.scheduler.enable_explain()
             self.scheduler.hard_pod_affinity_weight = float(
                 self.config.hard_pod_affinity_symmetric_weight)
             # the fused engines honor the plugin composition through traced
@@ -457,9 +506,23 @@ class SchedulerServer:
 
         self.comparer = CacheComparer(self.scheduler.cache, self.client)
         install_sigusr2(self.comparer)
+        # decision provenance (ISSUE 10): rich FailedScheduling events flow
+        # through the apiserver on the APIBinder transport discipline (the
+        # PR 8 retry budget) — wired here, where the informer lister can
+        # supply involvedObject UIDs
+        if self.scheduler.explainer is not None \
+                and self.scheduler.explainer.sink is None:
+            from kubernetes_tpu.sched.explain import APIEventSink
+
+            self.scheduler.explainer.sink = APIEventSink(
+                self.client, component=self.scheduler.scheduler_name,
+                pod_lookup=lambda ns, name: (
+                    self.pod_informer.lister.get(ns, name)
+                    if self.pod_informer is not None else None))
         if self.telemetry_port is not None:
             self.telemetry_gateway = TelemetryGateway(
-                self.scheduler.telemetry, port=self.telemetry_port).start()
+                self.scheduler.telemetry, port=self.telemetry_port,
+                scheduler=self.scheduler).start()
         t = threading.Thread(target=self._loop, daemon=True,
                              name="scheduler-loop")
         t.start()
@@ -593,8 +656,19 @@ class SchedulerServer:
         self.total_scheduled += stats.scheduled
         if stats.unschedulable:
             self.total_unschedulable_events += stats.unschedulable
-        # FailedScheduling events, as scheduler.go:436-448 records on FitError
+        # FailedScheduling events, as scheduler.go:436-448 records on
+        # FitError. With decision provenance on, the explainer already
+        # emitted the rich per-predicate events from inside the wave for
+        # every pod it ATTRIBUTED — the generic message would double-post
+        # a weaker duplicate for those. But failure paths the attribution
+        # never sees (extender rejections, framework rollbacks, the
+        # gang-host-rounds route, a failed attribution readback) must
+        # still get the generic event: gate per pod on whether an
+        # attribution doc exists, not on the explainer's mere presence.
+        explainer = self.scheduler.explainer
         for key in stats.failed_keys:
+            if explainer is not None and explainer.why(key) is not None:
+                continue
             ns, name = meta.split_key(key)
             obj = self.pod_informer.lister.get(ns, name) \
                 if self.pod_informer else None

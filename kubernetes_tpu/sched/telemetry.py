@@ -164,6 +164,27 @@ class _WaveSpan:
 
 _NULL_SPAN = _NullSpan()
 
+#: flight-recorder ring bounds for KTPU_FLIGHT_RING (a ring of 0 would
+#: record nothing silently; an unbounded one defeats "bounded")
+FLIGHT_RING_DEFAULT = 64
+FLIGHT_RING_MIN = 1
+FLIGHT_RING_MAX = 65536
+
+
+def flight_ring_capacity(default: int = FLIGHT_RING_DEFAULT) -> int:
+    """Bounds-checked KTPU_FLIGHT_RING parse: the flight-recorder ring
+    size. Unset/empty/garbage → the default; numeric values clamp into
+    [FLIGHT_RING_MIN, FLIGHT_RING_MAX] — an operator typo must degrade to
+    a sane ring, never crash the scheduler or disable recording."""
+    raw = os.environ.get("KTPU_FLIGHT_RING", "")
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return min(max(v, FLIGHT_RING_MIN), FLIGHT_RING_MAX)
+
 
 class SchedulerTelemetry:
     """The scheduler-wide observability layer: one per Scheduler (and one
@@ -171,12 +192,16 @@ class SchedulerTelemetry:
     split arrive from watchdog worker threads; everything else runs on the
     serving loop."""
 
-    def __init__(self, name: str = "scheduler", capacity: int = 64,
+    def __init__(self, name: str = "scheduler", capacity: Optional[int] = None,
                  clock: Callable[[], float] = time.perf_counter,
                  enabled: Optional[bool] = None,
                  slow_wave_threshold: float = 30.0) -> None:
         if enabled is None:
             enabled = os.environ.get("KTPU_TELEMETRY", "1") not in ("0", "off")
+        if capacity is None:
+            # KTPU_FLIGHT_RING: ring size, bounds-checked (explicit ctor
+            # capacities — tests — win over the env)
+            capacity = flight_ring_capacity()
         self.name = name
         self.enabled = enabled
         self.clock = clock

@@ -225,3 +225,94 @@ class TestSchedulerExposition:
         finally:
             sched.stop()
             api.close()
+
+
+class TestExpositionConformance:
+    """ISSUE 10 satellite: /metrics text-format conformance
+    (component/metrics.py) — `# HELP`/`# TYPE` lines and label-value
+    escaping, verified by a round-trip through a format parser."""
+
+    @staticmethod
+    def _parse(text):
+        """A strict text-exposition parser: returns ({name: type},
+        {name: help}, {(name, frozenset(labels.items())): value}).
+        Raises on any line it cannot parse — malformed escaping fails the
+        round-trip instead of silently mis-parsing."""
+        import re
+
+        types, helps, samples = {}, {}, {}
+        label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP "):
+                _, _, rest = line.partition("# HELP ")
+                name, _, help_ = rest.partition(" ")
+                helps[name] = help_.replace("\\n", "\n") \
+                    .replace("\\\\", "\\")
+                continue
+            if line.startswith("# TYPE "):
+                _, _, rest = line.partition("# TYPE ")
+                name, _, tp = rest.partition(" ")
+                assert tp in ("counter", "gauge", "histogram"), line
+                types[name] = tp
+                continue
+            assert not line.startswith("#"), f"unknown comment: {line!r}"
+            m = re.match(
+                r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$', line)
+            assert m, f"unparseable sample line: {line!r}"
+            name, _, labels_raw, value = m.groups()
+            labels = {}
+            if labels_raw:
+                consumed = 0
+                for lm in label_re.finditer(labels_raw):
+                    raw = lm.group(2)
+                    labels[lm.group(1)] = (
+                        raw.replace("\\n", "\n").replace('\\"', '"')
+                        .replace("\\\\", "\\"))
+                    consumed = lm.end()
+                rest = labels_raw[consumed:].strip(",")
+                assert not rest, f"trailing label garbage: {rest!r}"
+            samples[(name, frozenset(labels.items()))] = float(value)
+        return types, helps, samples
+
+    def test_round_trip_with_hostile_label_values(self):
+        from kubernetes_tpu.component.metrics import Registry
+
+        reg = Registry()
+        c = reg.counter("demo_total", 'counts "things"\nper line',
+                        labels=("who",))
+        hostile = 'ten"ant\\one\nx'
+        c.inc(3, who=hostile)
+        c.inc(2, who="plain")
+        g = reg.gauge("demo_gauge", "a gauge", labels=("lane",))
+        g.set(7.5, lane="a,b=c")  # commas/equals inside a value
+        h = reg.histogram("demo_seconds", "latency", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+
+        types, helps, samples = self._parse(reg.expose_text())
+        assert types == {"demo_total": "counter", "demo_gauge": "gauge",
+                         "demo_seconds": "histogram"}
+        assert helps["demo_total"] == 'counts "things"\nper line'
+        # the hostile label value survives the round trip EXACTLY
+        assert samples[("demo_total",
+                        frozenset({("who", hostile)}.union()))] == 3.0
+        assert samples[("demo_total", frozenset([("who", "plain")]))] == 2.0
+        assert samples[("demo_gauge", frozenset([("lane", "a,b=c")]))] == 7.5
+        # histogram: cumulative le buckets + sum + count
+        assert samples[("demo_seconds_bucket",
+                        frozenset([("le", "0.1")]))] == 1.0
+        assert samples[("demo_seconds_bucket",
+                        frozenset([("le", "1.0")]))] == 1.0
+        assert samples[("demo_seconds_bucket",
+                        frozenset([("le", "+Inf")]))] == 2.0
+        assert samples[("demo_seconds_count", frozenset())] == 2.0
+
+    def test_default_registry_exposition_parses_clean(self):
+        import kubernetes_tpu.sched.metrics  # noqa: F401 - registers
+        from kubernetes_tpu.component.metrics import DEFAULT_REGISTRY
+
+        types, _helps, _samples = self._parse(
+            DEFAULT_REGISTRY.expose_text())
+        assert "scheduler_pending_pods" in types
