@@ -102,6 +102,14 @@ DEFAULT_STAGES = [
                              # one vmap'd dispatch per tick, DRF quotas,
                              # zero cross-tenant placements (flagship
                              # target: 100 × 5k, docs/FLEET.md)
+    (250, 1250, "watchplane"),  # ISSUE 13: 16 tenants on ONE mux'd watch
+                                # stream per resource through a real
+                                # apiserver — a 10k ev/s storm with a
+                                # mid-storm compaction (bookmark resume,
+                                # not relist), a deaf-route stall, a
+                                # mux-kill + revive, and a restart drill;
+                                # 0 lost / 0 double-bound
+
     (5120, 50000, "multichip"),  # engine dryrun rungs → MULTICHIP_OUT
     (2000, 40000, "gang"),   # mid rung: a 5k gang timeout still leaves a number
     (5000, 100000, "gang"),
@@ -163,6 +171,11 @@ CYCLE_BUDGETS = {
     # virtual tenant mesh on CPU): the vmapped wave program over 16
     # stacked tenants — the cold compile is excluded (first tick)
     ("fleet", 1000): 300.0,
+    # worst steady watchplane tick: 16 tenants' vmapped wave plus the
+    # ingest path (apiserver → pump → mux → routes) running concurrently
+    # on the same CPU box; the cold compile tick is excluded, and the
+    # revive-blocked tick (mux-kill drill) stays inside this bound
+    ("watchplane", 250): 300.0,
 }
 
 # Per-metric budgets beyond the cycle time (the host-pipeline-overlap PR's
@@ -275,6 +288,19 @@ METRIC_BUDGETS = {
                       # shape while the feature under test does nothing
                       "drf_clamped": (">=", 1),
                       "tenants_lossless": (">=", 1)},
+    # ISSUE 13 acceptance: K tenants ride ONE upstream watch stream per
+    # resource (not K); the storm — with a mid-storm compaction, a deaf
+    # route, a mux-kill and an apiserver-restart drill — costs at most 2
+    # relists fleet-wide (bookmark/RV resumes absorb the rest); at least
+    # one deaf consumer was evicted (bounded buffers actually enforced);
+    # at least one resume was bookmark-funded (the quiet-stream compaction
+    # immunity); and nothing is lost or double-bound through all of it
+    ("watchplane", 250): {"upstream_watches_per_resource": ("<=", 1),
+                          "relists_during_storm": ("<=", 2),
+                          "lost_pods": ("<=", 0),
+                          "double_bound": ("<=", 0),
+                          "deaf_evictions": (">=", 1),
+                          "bookmark_resumes": (">=", 1)},
 }
 
 
@@ -327,7 +353,7 @@ def _run_stage(n_nodes, n_pods, kind, env, timeout):
     """Run one shape in a subprocess; returns a result dict (never raises)."""
     global _CURRENT_PROC
     env = dict(env)
-    if kind not in ("chaos", "failover", "overload"):
+    if kind not in ("chaos", "failover", "overload", "watchplane"):
         # FAULT_SPEC is the fault-drill stages' contract alone: an operator
         # running the documented drill (FAULT_SPEC=... python bench.py)
         # must not have faults injected into the other stages' budgets.
@@ -1378,6 +1404,252 @@ def _fleet_stage(n_nodes, n_pods):
     }))
 
 
+def _watchplane_stage(n_nodes, n_pods):
+    """ISSUE 13 acceptance stage: the fleet watch plane under storm. K
+    virtual tenants (default 16, KTPU_FLEET_TENANTS) ride ONE multiplexed
+    watch stream per resource through a REAL apiserver: tenant-labeled pods
+    are created at the 10k ev/s target rate (KTPU_WATCHPLANE_EVENTS_PER_S)
+    while the fleet ticks concurrently. Mid-storm the drill injects (a) a
+    compaction at the live floor — boundary bookmarks keep every stream
+    resumable, (b) a deaf route (`watch.stall@<tenant>`) — evicted and
+    resynced from the mux indexer, never the apiserver, (c) a mux-kill
+    (`mux.die@pods`) — tenants serve cached state with staleness visible
+    until the tick's maintain() revives the stream as a RESUME, and (d) a
+    post-storm apiserver restart (`drop_watchers`) — the quiet nodes stream
+    resumes from its BOOKMARKED RV. METRIC_BUDGETS enforce ≤1 upstream
+    stream per resource, ≤2 relists through the whole storm, ≥1 deaf
+    eviction, ≥1 bookmark-funded resume, 0 lost / 0 double-bound."""
+    import threading as _threading
+
+    import jax
+
+    # fast bookmark pulse: quiet streams must advance their resume tokens
+    # on the drill's timescale, and staleness must visibly decay
+    os.environ.setdefault("KTPU_WATCH_BOOKMARK_INTERVAL", "1")
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import Client
+    from kubernetes_tpu.fleet import FleetServer
+    from kubernetes_tpu.sched.scheduler import RecordingBinder
+    from kubernetes_tpu.state.dims import Dims, bucket
+    from kubernetes_tpu.utils import faultline
+
+    tenants = int(os.environ.get("KTPU_FLEET_TENANTS", "16"))
+    rate = float(os.environ.get("KTPU_WATCHPLANE_EVENTS_PER_S", "10000"))
+    total_events = tenants * n_pods
+    names = [f"t{k:02d}" for k in range(tenants)]
+
+    api = APIServer()
+    client = Client.local(api)
+    st = api.storage
+
+    batch = min(4096, max(64, n_pods // 2))
+    base = Dims(N=bucket(n_nodes), P=bucket(batch), E=bucket(n_pods + 256))
+    clk = {"t": 0.0}
+    srv = FleetServer(batch_size=batch, base_dims=base,
+                      clock=lambda: clk["t"])
+    srv.prewarmer.enabled = False
+    binders = {}
+    for name in names:
+        binders[name] = RecordingBinder()
+        srv.add_tenant(name, binder=binders[name])
+    plane = srv.attach_watch_plane(client)
+
+    # an apiserver-level deaf consumer: a tiny-buffer watch nobody drains —
+    # the storm must evict IT, not stall the broadcast
+    deaf_watch = st.watch("/registry/core/pods/", buffer=64)
+
+    def v1pod(name, tenant, i):
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": name, "namespace": "default",
+                             "labels": {"ktpu.io/tenant": tenant}},
+                "spec": {"containers": [{"name": "c", "image": "i",
+                         "resources": {"requests": {
+                             "cpu": "20m", "memory": "16Mi"}}}]}}
+
+    # ---- nodes (pre-storm; not storm-counted) ------------------------- #
+    t0 = time.perf_counter()
+    for name in names:
+        for i in range(n_nodes):
+            client.nodes.create({
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": f"{name}-n{i}",
+                             "labels": {"ktpu.io/tenant": name,
+                                        "kubernetes.io/hostname":
+                                            f"{name}-n{i}"}},
+                "status": {"allocatable": {"cpu": "32", "memory": "128Gi",
+                                           "pods": "110"}}})
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and any(
+            t.sched.cache.node_count < n_nodes
+            for t in srv.tenants.values()):
+        time.sleep(0.05)
+    t_nodes = time.perf_counter() - t0
+    relists_pre = sum(m.informer.relists for m in plane.muxes)
+
+    # drills armed AFTER node ingest so the seam hit counters see storm
+    # traffic only (faultline counts hits per (fault, site) across BOTH
+    # muxes — arming earlier let the ~K×n_nodes pre-storm node fan calls
+    # consume hits, firing the "mid-storm" stall during setup and the mux
+    # death well before its ~60% mark). FAULT_SPEC from the driver env can
+    # override — watchplane is a drill-club stage: a deaf route partway
+    # in, the pump's floor-compaction seam, and a mux-stream death at ~60%
+    # of the storm.
+    spec = os.environ.get("FAULT_SPEC") or (
+        f"watch.stall@{names[min(3, tenants - 1)]}:50,"
+        f"watch.compact@floor:24,"
+        f"mux.die@pods:{max(total_events * 3 // 5, 100)}")
+    faultline.install(spec)
+
+    # ---- the storm: paced creates on a generator thread, fleet ticks on
+    # the main thread (the full ingest path runs END TO END: apiserver →
+    # storage pump → ONE informer → mux routes → tenant queues → waves) - #
+    injected = {"n": 0}
+    gen_err = []
+
+    def gen():
+        t_start = time.monotonic()
+        i = 0
+        try:
+            while i < total_events:
+                due = min(total_events,
+                          int((time.monotonic() - t_start) * rate) + 1)
+                while i < due:
+                    name = names[i % tenants]
+                    client.pods.create(
+                        v1pod(f"{name}-p{i // tenants}", name, i))
+                    i += 1
+                    injected["n"] = i
+                    if i == total_events // 2:
+                        # deterministic mid-storm compaction at the pump's
+                        # dispatched revision — already-broadcast history
+                        # only, the honest cacher-compaction shape (the
+                        # pump's watch.compact@floor seam also fires on
+                        # its own clock)
+                        st.compact_to(st.dispatched_rev)
+                if i < total_events:
+                    time.sleep(0.0005)
+        except Exception as e:  # noqa: BLE001 — surfaced in the record
+            gen_err.append(repr(e))
+
+    gth = _threading.Thread(target=gen, name="storm-gen", daemon=True)
+    t_storm0 = time.perf_counter()
+    gth.start()
+    ticks = []
+    idle = 0
+    while time.perf_counter() - t_storm0 < 600:
+        c0 = time.perf_counter()
+        tk = srv.tick()
+        clk["t"] += 1.0
+        ticks.append((time.perf_counter() - c0, tk))
+        if gth.is_alive():
+            continue
+        if all(sum(t.sched.queue.lengths()) == 0
+               for t in srv.tenants.values()):
+            break
+        idle = idle + 1 if tk.scheduled == 0 else 0
+        if idle >= 6:
+            break  # stalled (budgets will flag the loss)
+    gth.join(timeout=5)
+    t_storm = time.perf_counter() - t_storm0
+    relists_storm_live = sum(m.informer.relists
+                             for m in plane.muxes) - relists_pre
+
+    # ---- post-storm: apiserver restart → resume by (bookmarked) RV ---- #
+    # the pods stream's token was event-advanced all storm; the NODES
+    # stream was quiet — only the bookmark pulse kept its token fresh, so
+    # ITS resume here is the bookmark-funded one the budget demands
+    time.sleep(1.5)  # ≥1 bookmark interval: quiet tokens advance first
+    st.drop_watchers()
+    for name in names:
+        client.pods.create(v1pod(f"{name}-rs", name, 0))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and any(
+            t.sched.queue.lengths()[0] == 0 and
+            f"default/{t.name}-rs" not in
+            {k for k, _ in binders[t.name].bound}
+            for t in srv.tenants.values()):
+        time.sleep(0.05)
+    for _ in range(8):
+        srv.tick()
+        clk["t"] += 1.0
+        if all(sum(t.sched.queue.lengths()) == 0
+               for t in srv.tenants.values()):
+            break
+    t_total = time.perf_counter() - t_storm0
+
+    # ---- accounting ---------------------------------------------------- #
+    created = n_pods + 1  # storm + the restart-drill pod, per tenant
+    lost_by_tenant = {}
+    double = 0
+    still_queued = 0
+    for name in names:
+        keys = [k for k, _ in binders[name].bound]
+        double += len(keys) - len(set(keys))
+        q = sum(srv.tenant(name).sched.queue.lengths())
+        still_queued += q
+        lost_by_tenant[name] = created - len(set(keys)) - q
+    lost = sum(lost_by_tenant.values())
+    scheduled = sum(len(set(k for k, _ in b.bound))
+                    for b in binders.values())
+    upstream = max(st.live_watchers("/registry/core/pods/"),
+                   st.live_watchers("/registry/core/nodes/"))
+    bm_resumes = sum(m.informer.bookmark_resumes for m in plane.muxes)
+    resumes = sum(m.informer.resumes for m in plane.muxes)
+    relists_total = sum(m.informer.relists for m in plane.muxes)
+    route_evictions = sum(m.stats()["route_evictions"]
+                          for m in plane.muxes)
+    steady = [w for w, _ in ticks[1:]] or [ticks[0][0]]
+    fl = faultline.active()
+    out = {
+        "nodes": n_nodes, "pods": n_pods, "kind": "watchplane",
+        "tenants": tenants,
+        "scheduled": scheduled,
+        "failed": max(tenants * created - scheduled - still_queued, 0),
+        "queued": still_queued,
+        "cycle_seconds": round(max(steady), 3),
+        "median_cycle_seconds": round(sorted(steady)[len(steady) // 2], 3),
+        "cold_tick_seconds": round(ticks[0][0], 3),
+        "ticks": len(ticks),
+        "node_ingest_seconds": round(t_nodes, 2),
+        "storm_events": injected["n"],
+        "events_per_sec_target": rate,
+        "events_per_sec": round(injected["n"] / t_storm, 1)
+        if t_storm else 0.0,
+        # the ISSUE 13 acceptance numbers. relists_during_storm = every
+        # relist after the initial syncs — through the compaction, the
+        # mux-kill AND the restart drill (resumes absorb them all in a
+        # healthy run; the budget allows 2 for ring-overrun edge cases)
+        "upstream_watches_per_resource": upstream,
+        "relists_during_storm": relists_total - relists_pre,
+        "relists_live_storm_window": relists_storm_live,
+        "relists_total": relists_total,
+        "resumes": resumes,
+        "bookmark_resumes": bm_resumes,
+        "bookmarks_seen": sum(m.informer.bookmarks_seen
+                              for m in plane.muxes),
+        "deaf_evictions": st.deaf_evictions + route_evictions,
+        "apiserver_deaf_evictions": st.deaf_evictions,
+        "route_evictions": route_evictions,
+        "route_resyncs": sum(m.stats()["route_resyncs"]
+                             for m in plane.muxes),
+        "mux_deaths": sum(m.deaths for m in plane.muxes),
+        "mux_failovers": plane.mux_failovers,
+        "max_staleness_seconds": round(plane.max_staleness, 3),
+        "final_staleness_seconds": round(plane.staleness(), 3),
+        "compaction_bookmarks": st.compaction_bookmarks,
+        "seams_fired": fl.counts() if fl is not None else {},
+        "lost_pods": lost,
+        "double_bound": double,
+        "gen_errors": gen_err,
+        "pods_per_sec": round(scheduled / t_total, 1) if t_total else 0.0,
+        "backend": jax.default_backend(),
+    }
+    deaf_watch.stop()
+    plane.stop()
+    api.close()
+    print(json.dumps(out))
+
+
 def _classes_stage(n_nodes, n_pods):
     """ISSUE 5 acceptance stage: equivalence-class collapsed admission on a
     deployment-style backlog (200 classes, replicas stamped in contiguous
@@ -2230,6 +2502,9 @@ def _stage_main(n_nodes, n_pods, kind):
     if kind == "fleet":
         _fleet_stage(n_nodes, n_pods)
         return
+    if kind == "watchplane":
+        _watchplane_stage(n_nodes, n_pods)
+        return
     if kind == "multichip":
         _multichip_stage(n_nodes, n_pods)
         return
@@ -2404,6 +2679,10 @@ def _compact_line(full, out_name, wrote):
             if r.get("kind") == "latency":
                 e["p50_ms"] = r.get("p50_ms")
                 e["p99_ms"] = r.get("p99_ms")
+            if r.get("kind") == "watchplane":
+                e["upstream"] = r.get("upstream_watches_per_resource")
+                e["relists"] = r.get("relists_during_storm")
+                e["bm_resumes"] = r.get("bookmark_resumes")
             if r.get("kind") == "overload":
                 e["mode_transitions"] = r.get("mode_transitions")
                 e["breaker_opens"] = r.get("breaker_opens")

@@ -117,7 +117,8 @@ class APIServer:
                  admission: Optional[AdmissionFn] = None,
                  scheme: Optional[Scheme] = None,
                  max_inflight: Optional[int] = None,
-                 max_mutating_inflight: Optional[int] = None):
+                 max_mutating_inflight: Optional[int] = None,
+                 watch_buffer: Optional[int] = None):
         from kubernetes_tpu.apiserver.admission import AdmissionChain
         from kubernetes_tpu.apiserver.crd import install_crd_hook
 
@@ -132,7 +133,11 @@ class APIServer:
         self.inflight = MaxInflightFilter(
             max_inflight, max_mutating_inflight) \
             if (max_inflight or max_mutating_inflight) else None
-        self.storage = storage or Storage()
+        # watch_buffer bounds every watcher's delivery buffer (ISSUE 13 —
+        # the cacher's per-watcher channel size; KTPU_WATCH_BUFFER env
+        # inside Storage otherwise): a consumer that stops draining is
+        # evicted with a too-old error, never allowed to balloon memory
+        self.storage = storage or Storage(watch_buffer=watch_buffer)
         self.scheme = scheme or build_scheme()
         if admission is None:
             admission = AdmissionChain()
@@ -575,8 +580,10 @@ def _handle_rest_admitted(api: APIServer, method: str, path: str,
     if faultline.should("apiserver.restart", "handle_rest"):
         # chaos: the apiserver process dies and comes back between two
         # requests. Storage (etcd) survives; every open watch connection
-        # does not — reflectors must re-establish/relist — and THIS request
-        # is the one that hit the connection-refused window.
+        # does not — each gets a terminal 503 Status first (ISSUE 13), so
+        # reflectors RESUME from their last resourceVersion instead of
+        # blind-relisting — and THIS request is the one that hit the
+        # connection-refused window.
         api.storage.drop_watchers()
         raise errors.new_service_unavailable(
             "apiserver restarting (chaos-injected)")

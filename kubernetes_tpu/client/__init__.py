@@ -21,6 +21,11 @@ from kubernetes_tpu.client.rest import (
     LocalTransport,
     ResourceClient,
 )
+from kubernetes_tpu.client.watchmux import (
+    TENANT_LABEL,
+    MuxRoute,
+    WatchMux,
+)
 from kubernetes_tpu.client.workqueue import (
     DelayingQueue,
     RateLimiter,
@@ -31,6 +36,7 @@ from kubernetes_tpu.client.workqueue import (
 __all__ = [
     "Client", "DelayingQueue", "EventRecorder", "HTTPTransport", "Indexer",
     "InformerFactory", "LeaderElectionConfig", "LeaderElector", "Lister",
-    "LocalTransport", "RateLimiter", "RateLimitingQueue", "ResourceClient",
-    "SharedInformer", "WorkQueue", "pods_by_node_index",
+    "LocalTransport", "MuxRoute", "RateLimiter", "RateLimitingQueue",
+    "ResourceClient", "SharedInformer", "TENANT_LABEL", "WatchMux",
+    "WorkQueue", "pods_by_node_index",
 ]

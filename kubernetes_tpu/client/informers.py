@@ -37,6 +37,18 @@ INFORMER_RELISTS = _REG.counter(
     "informer_relists_total",
     "Full list+replace rounds (initial sync, 410 Gone, deaf watch)",
     labels=("resource",))
+# ISSUE 13 watch plane: bookmarks keep a quiet stream's resume token fresh,
+# and resumes are the relists we DIDN'T pay — the ratio of these two series
+# against informer_relists_total is the watch plane's health at a glance.
+INFORMER_BOOKMARKS = _REG.counter(
+    "informer_bookmarks_total",
+    "BOOKMARK events received (resume token advanced without a relist)",
+    labels=("resource",))
+INFORMER_RESUMES = _REG.counter(
+    "informer_watch_resumes_total",
+    "Watch streams re-established from the last resourceVersion instead of "
+    "relisting, by what last advanced the token (bookmark vs event)",
+    labels=("resource", "via"))
 
 
 class RelistBackoff:
@@ -65,6 +77,14 @@ class RelistBackoff:
 
     def reset(self) -> None:
         self.attempts = 0
+
+    def collapse(self) -> None:
+        """Collapse the ladder to its FIRST rung (not a full reset): a
+        successful list proves the failure the backoff was pricing is
+        over, but a watch phase that keeps dying right after every good
+        list must still pace on rung 1, not the raw base cadence — only
+        a delivered watch signal earns `reset()`."""
+        self.attempts = min(self.attempts, 1)
 
 
 class Indexer:
@@ -193,6 +213,16 @@ class SharedInformer:
         self._thread: Optional[threading.Thread] = None
         self._watch: Optional[mwatch.Watch] = None
         self.last_sync_rv = ""
+        # watch-plane bookkeeping (ISSUE 13): how the resume token last
+        # advanced, and the resume/relist split the bench budgets read
+        self._rv_from_bookmark = False
+        self.relists = 0            # full list+replace rounds
+        self.resumes = 0            # re-watches from last_sync_rv
+        self.bookmark_resumes = 0   # ... where a BOOKMARK supplied the rv
+        self.bookmarks_seen = 0
+        # liveness: monotonic stamp of the last signal (event, bookmark, or
+        # successful list) — the staleness metric's denominator upstream
+        self.last_signal = time.monotonic()
 
     # -- handler registration (AddEventHandler) ----------------------------- #
 
@@ -209,6 +239,27 @@ class SharedInformer:
     # -- lifecycle ---------------------------------------------------------- #
 
     def start(self) -> "SharedInformer":
+        """Start — or RESTART — the reflector. A stopped informer keeps its
+        indexer and last_sync_rv, so starting it again is a watch RESUME
+        (the WatchMux revive path rides this: a mux-stream death must not
+        cost a relist when the resume token is still above the floor)."""
+        if self._thread is not None and self._thread.is_alive():
+            if not self._stop.is_set():
+                return self  # genuinely running
+            # the old lifecycle is stopping but its thread outlived
+            # stop()'s bounded join (wedged in a synchronous handler).
+            # Returning here would leave NO reflector once it exits, and
+            # replacing _stop while it still runs would resurrect it (the
+            # loop re-reads self._stop) — so wait it out, bounded, and
+            # fail LOUDLY rather than report a restart that never happened
+            self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"informer-{self.rc.resource}: previous lifecycle's "
+                    "thread is still exiting (a handler is likely wedged); "
+                    "cannot restart yet")
+        if self._stop.is_set():
+            self._stop = threading.Event()  # fresh lifecycle, old thread dead
         self._thread = threading.Thread(target=self._run,
                                         name=f"informer-{self.rc.resource}",
                                         daemon=True)
@@ -233,55 +284,82 @@ class SharedInformer:
     # -- the reflector loop (reflector.go:187 ListAndWatch) ----------------- #
 
     def _run(self) -> None:
+        # a RESTART of a previously-synced informer (WatchMux revive, a
+        # stopped-then-started reflector) resumes from its last token
+        # instead of relisting — the indexer and last_sync_rv survived
+        resume_first = self._synced.is_set() and bool(self.last_sync_rv)
         while not self._stop.is_set():
             t0 = time.monotonic()
             try:
-                self._list_and_watch()
+                self._list_and_watch(skip_list=resume_first)
             except Exception:  # noqa: BLE001 — reflector retries everything
                 pass
+            resume_first = False
             if time.monotonic() - t0 >= self._backoff_reset_after:
                 self.backoff.reset()  # the round was healthy for a while
             if self._stop.wait(self.backoff.next()):
                 return
 
-    def _list_and_watch(self) -> None:
-        INFORMER_RELISTS.inc(resource=self.rc.resource)
-        lst = self.rc.list(self.namespace, self.label_selector,
-                           self.field_selector)
-        items = lst.get("items", [])
-        rv = lst.get("metadata", {}).get("resourceVersion", "")
-        old_keys = set(self.indexer.keys())
-        # last-known objects become delete tombstones (DeltaFIFO
-        # DeletedFinalStateUnknown carries the final object, not just a key)
-        old_objs = {k: self.indexer.get(k) for k in old_keys}
-        self.indexer.replace(items)
-        self.last_sync_rv = rv
-        # synthesize deltas for the replace (DeltaFIFO Replace semantics)
-        new_keys = {meta.namespaced_key(o) for o in items}
-        with self._handler_mu:
-            handlers = list(self._handlers)
-        for o in items:
-            k = meta.namespaced_key(o)
-            for add, upd, _ in handlers:
-                if k in old_keys:
-                    # deliver the pre-gap cached object as old so diffing
-                    # handlers see changes that happened during the watch gap
-                    # (DeltaFIFO Replace semantics)
-                    upd(old_objs.get(k) or o, o)
-                else:
-                    add(o)
-        for k in old_keys - new_keys:
-            tomb = old_objs.get(k) or {"metadata": dict(zip(
-                ("namespace", "name"), meta.split_key(k)))}
-            for _, _, dele in handlers:
-                dele(tomb)
-        self._synced.set()
+    @staticmethod
+    def _error_code(obj) -> int:
+        """Status code off a watch ERROR event's payload (0 if unreadable)."""
+        try:
+            return int(obj.get("code") or 0)
+        except (AttributeError, TypeError, ValueError):
+            return 0
+
+    def _list_and_watch(self, skip_list: bool = False) -> None:
+        if not skip_list:
+            INFORMER_RELISTS.inc(resource=self.rc.resource)
+            self.relists += 1
+            lst = self.rc.list(self.namespace, self.label_selector,
+                               self.field_selector)
+            items = lst.get("items", [])
+            rv = lst.get("metadata", {}).get("resourceVersion", "")
+            old_keys = set(self.indexer.keys())
+            # last-known objects become delete tombstones (DeltaFIFO
+            # DeletedFinalStateUnknown carries the final object, not a key)
+            old_objs = {k: self.indexer.get(k) for k in old_keys}
+            self.indexer.replace(items)
+            self.last_sync_rv = rv
+            self._rv_from_bookmark = False
+            self.last_signal = time.monotonic()
+            # ANY successful list+replace collapses the relist ladder to
+            # its first rung (the old after-a-healthy-round-only reset
+            # left a watch that died right after the initial list
+            # retrying at the decayed cap forever); the full reset
+            # happens below, once the watch actually delivers a signal
+            self.backoff.collapse()
+            # synthesize deltas for the replace (DeltaFIFO Replace)
+            new_keys = {meta.namespaced_key(o) for o in items}
+            with self._handler_mu:
+                handlers = list(self._handlers)
+            for o in items:
+                k = meta.namespaced_key(o)
+                for add, upd, _ in handlers:
+                    if k in old_keys:
+                        # deliver the pre-gap cached object as old so
+                        # diffing handlers see changes that happened during
+                        # the watch gap (DeltaFIFO Replace semantics)
+                        upd(old_objs.get(k) or o, o)
+                    else:
+                        add(o)
+            for k in old_keys - new_keys:
+                tomb = old_objs.get(k) or {"metadata": dict(zip(
+                    ("namespace", "name"), meta.split_key(k)))}
+                for _, _, dele in handlers:
+                    dele(tomb)
+            self._synced.set()
 
         # Watch, RESUMING across clean stream ends: bookmarks keep
         # last_sync_rv fresh on quiet resources, so a dropped stream
         # re-watches from there (reflector.go re-establishes the watch
-        # from its lastSyncResourceVersion) — only an ERROR (410 Gone)
-        # forces the full relist this method restarts with.
+        # from its lastSyncResourceVersion). Only a GENUINE 410 Gone —
+        # the resume token fell beneath the compaction floor — forces the
+        # full relist this method restarts with; any other terminal ERROR
+        # (an apiserver restart's 503, a converter failure) re-establishes
+        # by resourceVersion, which is the whole point of ISSUE 13: one
+        # compaction blip must not become a fleet-wide list storm.
         # Silence bound: a healthy opted-in stream carries a bookmark at
         # least every KTPU_WATCH_BOOKMARK_INTERVAL (10s default); total
         # silence far beyond that means the watch is deaf (e.g. resumed
@@ -294,7 +372,22 @@ class SharedInformer:
         silence_limit = max(9 * float(_os.environ.get(
             "KTPU_WATCH_BOOKMARK_INTERVAL", "10") or 10), 90.0)
         last_signal = time.monotonic()
+        first_stream = not skip_list
+        pending_resume: Optional[str] = None
         while not self._stop.is_set():
+            if not first_stream:
+                # this re-watch IS the resume path (classified by what last
+                # advanced the token — a bookmark-funded resume is the
+                # compaction-immunity signal the bench asserts) — but it is
+                # only COUNTED once the re-established stream delivers its
+                # first signal: an attempt that is refused, insta-closes,
+                # or 410s straight into a relist never resumed anything,
+                # and counting it would falsely certify the bookmark
+                # property (each new attempt overwrites the pending slot)
+                pending_resume = ("bookmark" if self._rv_from_bookmark
+                                  else "event")
+            first_stream = False
+            error_break = False
             w = self.rc.watch(self.namespace, self.label_selector,
                               self.field_selector,
                               resource_version=self.last_sync_rv,
@@ -309,10 +402,49 @@ class SharedInformer:
                         if time.monotonic() - last_signal > silence_limit:
                             return  # deaf watch → full relist
                         continue
-                    last_signal = time.monotonic()
                     if ev.type == mwatch.ERROR:
-                        # 410 Gone → relist from scratch (reflector relist)
-                        return
+                        # ERROR frames are NOT liveness: a server stuck
+                        # erroring every resume must eventually trip the
+                        # silence bound below and relist, not spin forever
+                        if self._error_code(ev.object) == 410:
+                            # 410 Gone: the token is beneath the compaction
+                            # floor — only a full relist can close the gap
+                            return
+                        # any other terminal error (restart 503, a 429
+                        # refused re-establishment, stream teardown): the
+                        # token is still good — resume, but UNDER THE
+                        # LADDER: a refused watch is server pushback, and
+                        # re-watching at the bare 0.05 s resume cadence
+                        # would hammer a saturated apiserver ~20×/s (the
+                        # ladder fully resets on the first real signal)
+                        error_break = True
+                        break
+                    last_signal = time.monotonic()
+                    self.last_signal = last_signal
+                    # the watch phase is demonstrably alive: NOW the round
+                    # is healthy and the relist ladder fully resets (the
+                    # counterpart of the rung-1 collapse after the list)
+                    if self.backoff.attempts:
+                        self.backoff.reset()
+                    if pending_resume is not None:
+                        # first delivered signal on a re-established watch:
+                        # the resume actually happened — count it now
+                        self.resumes += 1
+                        if pending_resume == "bookmark":
+                            self.bookmark_resumes += 1
+                        INFORMER_RESUMES.inc(resource=self.rc.resource,
+                                             via=pending_resume)
+                        pending_resume = None
+                    if ev.type == mwatch.BOOKMARK:
+                        # the server's liveness+progress pulse: advance the
+                        # resume token without touching the indexer
+                        rv = meta.resource_version(ev.object)
+                        if rv:
+                            self.last_sync_rv = rv
+                            self._rv_from_bookmark = True
+                        self.bookmarks_seen += 1
+                        INFORMER_BOOKMARKS.inc(resource=self.rc.resource)
+                        continue
                     if faultline.should("watch.drop", "informer"):
                         # chaos: the stream dies mid-flight and THIS event
                         # is lost with it — the resume from last_sync_rv
@@ -328,13 +460,22 @@ class SharedInformer:
                         # at-least-once contract makes the redelivery safe.
                         return
                     self._dispatch(ev)
-                    self.last_sync_rv = meta.resource_version(ev.object) or \
-                        self.last_sync_rv
+                    rv = meta.resource_version(ev.object)
+                    if rv:
+                        self.last_sync_rv = rv
+                        self._rv_from_bookmark = False
             finally:
                 w.stop()
                 self._watch = None
             if time.monotonic() - last_signal > silence_limit:
                 return  # repeated silent resumes → full relist
+            if error_break:
+                # terminal-error resumes pace on the relist ladder (capped
+                # exponential + jitter): consecutive refusals escalate,
+                # the first delivered signal resets
+                if self._stop.wait(self.backoff.next()):
+                    return
+                continue
             if self._stop.wait(0.05):
                 return  # brief pause: a server that insta-closes streams
                 # must not spin the resume loop hot

@@ -87,7 +87,18 @@ class LocalTransport:
 
         q = dict(query)
         q["watch"] = "true"
-        tag, w = handle_rest(self.api, "GET", path, q, None)
+        try:
+            tag, w = handle_rest(self.api, "GET", path, q, None)
+        except errors.StatusError as e:
+            # a REFUSED watch (410 Gone on a compacted resume RV, a restart
+            # window's 503) surfaces as a terminal watch ERROR event — the
+            # same shape the HTTP transport's pump delivers — so the
+            # reflector's relist-vs-resume decision reads ONE code path on
+            # both transports instead of a raised exception on one and a
+            # Status event on the other
+            w = mwatch.Watch(capacity=1)
+            w.terminate(mwatch.Event(mwatch.ERROR, e.status()))
+            return w
         assert tag == "WATCH"
         return w
 

@@ -33,6 +33,7 @@ degrade, which the chaos suite asserts from metrics, not logs.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field, replace
 from typing import Callable, Dict, List, Optional, Tuple
@@ -47,6 +48,236 @@ from ..utils import faultline
 from .cycle import dispatch_fleet, fleet_signature
 from .quota import violation_headroom
 from .tables import FleetStack, fleet_dims
+
+
+class _TenantIngest:
+    """The v1-dict → typed conversion shim between one tenant's mux routes
+    and its Scheduler — the per-tenant half of SchedulerServer's event
+    handlers (eventhandlers.go), minus everything the fleet owns."""
+
+    def __init__(self, tenant: "FleetTenant"):
+        # imports resolved ONCE here, not per event: these handlers sit on
+        # the storm-rate ingest hot path (10k ev/s across the routes), and
+        # a function-local import is a sys.modules lookup per call
+        from ..api.v1 import node_from_v1, pod_from_v1
+        from ..machinery import meta
+        from ..sched.server import apply_pod_update_v1, pod_schedulable_v1
+
+        self.tenant = tenant
+        self._seq = 0
+        self._pod_from_v1 = pod_from_v1
+        self._node_from_v1 = node_from_v1
+        self._meta_name = meta.name
+        self._pod_schedulable_v1 = pod_schedulable_v1
+        self._apply_pod_update_v1 = apply_pod_update_v1
+
+    def _to_pod(self, obj):
+        p = self._pod_from_v1(obj)
+        self._seq += 1
+        p.creation_index = self._seq
+        return p
+
+    # every handler holds the tenant's ingest lock — the per-tenant
+    # "event handlers vs waves" serialization SchedulerServer._mu provides
+    # for the single-cluster path (multi-step cache/queue transitions must
+    # not interleave with the tick's pop/commit on the same tenant)
+
+    def on_pod_add(self, obj) -> None:
+        if self._pod_schedulable_v1(obj):
+            with self.tenant.ingest_mu:
+                self.tenant.on_pod_add(self._to_pod(obj))
+
+    def on_pod_update(self, old, new) -> None:
+        # the SAME transition logic as SchedulerServer's informer handler
+        # (sched/server.apply_pod_update_v1) — one definition, two ingest
+        # paths that cannot drift
+        with self.tenant.ingest_mu:
+            self._apply_pod_update_v1(self.tenant.sched, old, new,
+                                      self._to_pod)
+
+    def on_pod_delete(self, obj) -> None:
+        with self.tenant.ingest_mu:
+            self.tenant.on_pod_delete(self._pod_from_v1(obj))
+
+    def on_node_add(self, obj) -> None:
+        with self.tenant.ingest_mu:
+            self.tenant.on_node_add(self._node_from_v1(obj))
+
+    def on_node_update(self, old, new) -> None:
+        with self.tenant.ingest_mu:
+            self.tenant.on_node_update(self._node_from_v1(new))
+
+    def on_node_delete(self, obj) -> None:
+        with self.tenant.ingest_mu:
+            self.tenant.on_node_delete(self._meta_name(obj))
+
+
+class FleetWatchPlane:
+    """ISSUE 13: ONE multiplexed watch stream per resource for the whole
+    fleet. Two `WatchMux`es (pods, nodes) each own a single bookmark-
+    resumable SharedInformer; every tenant gets a bounded route keyed by
+    the tenant label. K tenants therefore put exactly 2 watch streams on
+    the apiserver — not 2×K — and a disruption costs at most one resume
+    (or, beneath the compaction floor, ONE relist) fleet-wide.
+
+    A mux-stream death does not drop ticks: tenants keep scheduling from
+    cached state while `tenant_staleness_seconds` grows; `maintain()`
+    (called from FleetServer.tick) narrates the death, revives the stream
+    (restart-as-resume), and the staleness decays back to ~0."""
+
+    def __init__(self, server: "FleetServer", client,
+                 tenant_label: Optional[str] = None, namespace: str = "",
+                 buffer: int = 4096, auto_revive: bool = True):
+        from ..client.informers import SharedInformer
+        from ..client.watchmux import TENANT_LABEL, WatchMux
+
+        self.server = server
+        self.client = client
+        self.tenant_label = tenant_label or TENANT_LABEL
+        self.auto_revive = auto_revive
+        self.pod_mux = WatchMux(
+            SharedInformer(client.pods, namespace=namespace),
+            tenant_label=self.tenant_label, buffer=buffer, name="pods")
+        self.node_mux = WatchMux(
+            SharedInformer(client.nodes),
+            tenant_label=self.tenant_label, buffer=buffer, name="nodes")
+        self._ingests: Dict[str, _TenantIngest] = {}
+        self.mux_failovers = 0       # deaths maintain() recovered from
+        self.max_staleness = 0.0     # worst staleness ever exported
+        self._dead_noted: set = set()  # mux_die narration latch (edge-
+        self._started = False          # triggered, not per-tick spam)
+
+    @property
+    def muxes(self):
+        return (self.pod_mux, self.node_mux)
+
+    def add_route(self, tenant: "FleetTenant") -> None:
+        ing = _TenantIngest(tenant)
+        self._ingests[tenant.name] = ing
+        self.pod_mux.route(tenant.name, on_add=ing.on_pod_add,
+                           on_update=ing.on_pod_update,
+                           on_delete=ing.on_pod_delete)
+        self.node_mux.route(tenant.name, on_add=ing.on_node_add,
+                            on_update=ing.on_node_update,
+                            on_delete=ing.on_node_delete)
+
+    def start(self) -> "FleetWatchPlane":
+        for t in self.server.tenants.values():
+            if t.name not in self._ingests:
+                self.add_route(t)
+        for m in self.muxes:
+            m.start()
+        for m in self.muxes:
+            if not m.wait_for_sync(30.0):
+                # a sync timeout must not read as a healthy start: the
+                # fleet would tick against empty tenant caches with
+                # nothing distinguishing that from a quiet cluster —
+                # narrate it (flight-recorder visible, same channel as
+                # mux_die) and let staleness carry the ongoing signal
+                self.server.telemetry.note_supervisor_event(
+                    "mux_unsynced",
+                    f"{m.name}: initial list+watch did not sync within "
+                    "30s; ticking against unsynced caches until it does")
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        # a deliberate stop must not read as a death: maintain() guards on
+        # _started, so clearing it keeps the next tick from auto-reviving
+        # muxes whose route drain threads have already exited (events
+        # would flow upstream into silently no-op'ing routes — staleness
+        # ~0 while every tenant cache is frozen)
+        self._started = False
+        for m in self.muxes:
+            m.stop()
+        if self.server.watch_plane is self:
+            # make attach_watch_plane's "stop() it first" instruction
+            # actually work: a stopped plane detaches itself
+            self.server.watch_plane = None
+
+    def staleness(self) -> float:
+        """Seconds since the LEAST-recently-heard-from upstream stream —
+        bookmarks count, so a healthy quiet fleet sits near the bookmark
+        interval's remainder, never growing."""
+        now = time.monotonic()
+        return max(0.0, now - min(m.last_signal for m in self.muxes))
+
+    def tenant_staleness(self) -> Dict[str, float]:
+        """Per-tenant staleness: the upstream-stream staleness, PLUS a
+        route-local penalty for any tenant whose route still has
+        undelivered backlog (a stalled consumer is behind even when the
+        upstream is live — its serving state is only as fresh as the last
+        event it actually applied)."""
+        now = time.monotonic()
+        fleet = self.staleness()
+        out: Dict[str, float] = {}
+        # snapshot: a late add_tenant() -> add_route() inserts into
+        # _ingests from another thread mid-tick; iterating the live dict
+        # would RuntimeError out of the fleet tick
+        for name in list(self._ingests):
+            stale = fleet
+            for m in self.muxes:
+                r = m.routes.get(name)
+                if r is not None and r.depth() > 0:
+                    stale = max(stale, now - r.last_event)
+            out[name] = max(0.0, stale)
+        return out
+
+    def maintain(self) -> float:
+        """Per-tick upkeep: export staleness, revive dead streams. Returns
+        the worst staleness exported (pre-revive, so the tick that
+        discovers a death records how stale its serving state actually
+        was)."""
+        from ..sched.metrics import observe_tenant_staleness
+
+        if not self._started:
+            return 0.0
+        per_tenant = self.tenant_staleness()
+        stale = max(per_tenant.values(), default=self.staleness())
+        self.max_staleness = max(self.max_staleness, stale)
+        observe_tenant_staleness(per_tenant)
+        for m in self.muxes:
+            if not m.alive:
+                # edge-triggered narration: with auto_revive=False a dead
+                # stream stays dead across ticks, and a per-tick mux_die
+                # would flood every wave record with duplicates — the
+                # staleness gauge carries the ongoing signal, the event
+                # marks the death
+                if m.name not in self._dead_noted:
+                    self._dead_noted.add(m.name)
+                    self.server.telemetry.note_supervisor_event(
+                        "mux_die", f"{m.name}: stream dead, serving cached "
+                        f"state ({stale:.1f}s stale)")
+                if self.auto_revive:
+                    try:
+                        m.revive()
+                    except RuntimeError as e:
+                        # a wedged informer thread (start()'s bounded
+                        # re-join expired) must not turn into a fleet-wide
+                        # tick exception — "ticks are never dropped for a
+                        # watch outage": narrate, keep serving cached
+                        # state, retry the revive next tick
+                        self.server.telemetry.note_supervisor_event(
+                            "mux_revive_failed", f"{m.name}: {e}")
+                        continue
+                    self.mux_failovers += 1
+                    self._dead_noted.discard(m.name)
+                    self.server.telemetry.note_supervisor_event(
+                        "mux_revive",
+                        f"{m.name}: resumed (relists={m.informer.relists}, "
+                        f"resumes={m.informer.resumes})")
+            else:
+                self._dead_noted.discard(m.name)
+        return stale
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "upstream_watches_per_resource": 1,
+            "mux_failovers": self.mux_failovers,
+            "max_staleness_seconds": round(self.max_staleness, 3),
+            "pods": self.pod_mux.stats(),
+            "nodes": self.node_mux.stats(),
+        }
 
 
 def tenant_ledger(storage, tenant: str,
@@ -89,6 +320,13 @@ class FleetTenant:
             self.sched.governor.name = name
             self.sched.governor.breaker.name = name
         self.storm_ticks = 0
+        # serializes THIS tenant's event ingest (watch-plane route threads)
+        # against the tick's mutating phases on the same tenant — the
+        # per-tenant analog of SchedulerServer._mu ("event handlers vs
+        # waves"): multi-step cache/queue transitions on either side must
+        # not interleave. One lock per tenant, so ingest for tenant A never
+        # stalls behind tenant B's commit loop.
+        self.ingest_mu = threading.Lock()
 
     # -- event-ingest passthrough (the informer routing surface) -- #
 
@@ -127,6 +365,8 @@ class FleetTickStats:
     cross_tenant_placements: int = 0  # placements onto a node row outside
                                       # the tenant's own cluster (budget: 0)
     tick_seconds: float = 0.0
+    staleness_seconds: float = 0.0    # watch-plane staleness at tick start
+                                      # (0.0 when no watch plane attached)
 
     @property
     def scheduled(self) -> int:
@@ -179,6 +419,11 @@ class FleetServer:
         # re-admission rewarm must target the FLEET mesh's executable key
         # (the supervisor has no node-axis mesh_state here)
         self.supervisor.mesh_provider = lambda: self.mesh
+        # ISSUE 13: the shared watch plane (attach_watch_plane) — one
+        # multiplexed, bookmark-resumable stream per resource for all K
+        # tenants, maintained (staleness export + dead-stream revive)
+        # from every tick
+        self.watch_plane: Optional[FleetWatchPlane] = None
 
     def _supervisor_epoch(self):
         """Changes whenever a primary dispatch hung/failed or the backend
@@ -222,17 +467,45 @@ class FleetServer:
         t = FleetTenant(name, binder, quota=quota, ledger=ledger,
                         fence_source=fence_source, clock=self.clock)
         self.tenants[name] = t
+        if self.watch_plane is not None:
+            # a late tenant joins the EXISTING streams: its routes resync
+            # from the mux indexers — the apiserver sees no new watch
+            self.watch_plane.add_route(t)
         return t
 
     def tenant(self, name: str) -> FleetTenant:
         return self.tenants[name]
 
+    def attach_watch_plane(self, client, tenant_label: Optional[str] = None,
+                           namespace: str = "", buffer: int = 4096,
+                           auto_revive: bool = True,
+                           start: bool = True) -> FleetWatchPlane:
+        """Wire the fleet to a live apiserver through ONE multiplexed watch
+        stream per resource (ISSUE 13). Registers a route per existing
+        tenant; tenants added later join the same streams."""
+        if self.watch_plane is not None:
+            # silently replacing a live plane would leave the old one's
+            # informer + route threads running — double ingest per event
+            # and 2 leaked upstream streams, the exact amplification this
+            # subsystem exists to kill
+            raise ValueError("a watch plane is already attached; stop() "
+                             "it first")
+        self.watch_plane = FleetWatchPlane(
+            self, client, tenant_label=tenant_label, namespace=namespace,
+            buffer=buffer, auto_revive=auto_revive)
+        if start:
+            self.watch_plane.start()
+        return self.watch_plane
+
     def recover(self, now: Optional[float] = None) -> Dict[str, object]:
         """Startup/takeover reconciliation, per tenant through its OWN
         ledger namespace — tenant A's replay can complete/release only
         entries under A's prefix; B's intents are not even listed."""
-        return {name: t.sched.recover(now=now)
-                for name, t in self.tenants.items()}
+        out = {}
+        for name, t in self.tenants.items():
+            with t.ingest_mu:
+                out[name] = t.sched.recover(now=now)
+        return out
 
     # ------------------------------------------------------------------ #
     # the fleet tick
@@ -272,66 +545,76 @@ class FleetServer:
         for t in tlist:
             tick.per_tenant[t.name] = CycleStats()
         span = self.telemetry.wave_span("fleet-tick")
+        if self.watch_plane is not None:
+            # watch-plane upkeep rides the tick: staleness export first
+            # (a dead stream's tick records HOW stale it served), then the
+            # dead-stream revive — ticks are never dropped for a watch
+            # outage, they degrade to cached state with a visible metric
+            tick.staleness_seconds = self.watch_plane.maintain()
 
         # ---- pump + storm seam + governed pop ---- #
+        # each tenant's pop phase holds ITS ingest lock (handlers-vs-waves,
+        # per tenant): a route thread's multi-step transition can't
+        # interleave with the pump/pop on the same tenant's queue
         batches: Dict[str, List] = {}
         for t in tlist:
-            s = t.sched
-            st = tick.per_tenant[t.name]
-            s.queue.pump(now)
-            s.cache.cleanup(now)
-            if faultline.should("tenant.storm", t.name):
-                # injected per-tenant watch storm: the tenant's resident
-                # encoding is no longer trusted (full re-encode next tick)
-                # and this tick admits nothing for it — purely ITS
-                # degradation, the other tenants' rows are untouched. The
-                # "storm" event makes this a flight-recorder dump trigger:
-                # the degraded tick is explainable from the artifact.
-                t.storm_ticks += 1
-                st.degraded += 1
-                self.telemetry.note_supervisor_event("storm", t.name)
-                s.cache.invalidate_snapshot()
-                batches[t.name] = []
-                continue
-            # per-TENANT overload governor (sched/overload.py): one
-            # tenant's storm sheds/pauses only that tenant — composing
-            # with the DRF clamp, which bounds a tenant's SHARE while the
-            # governor bounds the control plane's own burn for it
-            gov = s.governor
-            decision = None
-            pop_limit = self.batch_size
-            if gov is not None:
-                decision = gov.begin_wave(now, s.queue.depths())
-                if decision.release_deferred:
-                    released = s.queue.release_deferred(now)
-                    if released:
-                        self.telemetry.note_supervisor_event(
-                            "deferred_release",
-                            f"{t.name}: {released} pods re-admitted")
-                if not decision.dispatch_allowed:
-                    st.commit_paused += 1
+            with t.ingest_mu:
+                s = t.sched
+                st = tick.per_tenant[t.name]
+                s.queue.pump(now)
+                s.cache.cleanup(now)
+                if faultline.should("tenant.storm", t.name):
+                    # injected per-tenant watch storm: the tenant's resident
+                    # encoding is no longer trusted (full re-encode next tick)
+                    # and this tick admits nothing for it — purely ITS
+                    # degradation, the other tenants' rows are untouched. The
+                    # "storm" event makes this a flight-recorder dump trigger:
+                    # the degraded tick is explainable from the artifact.
+                    t.storm_ticks += 1
+                    st.degraded += 1
+                    self.telemetry.note_supervisor_event("storm", t.name)
+                    s.cache.invalidate_snapshot()
                     batches[t.name] = []
                     continue
-                if decision.wave_limit:
-                    pop_limit = min(pop_limit, decision.wave_limit)
-            batch = s.queue.pop_batch(pop_limit, now=now)
-            if decision is not None and decision.shed_below is not None \
-                    and batch:
-                kept = []
-                shed_n = 0
-                for pod, attempts in batch:
-                    if pod.priority < decision.shed_below \
-                            and s.queue.park_deferred(pod, attempts,
-                                                      now=now):
-                        shed_n += 1
-                    else:
-                        kept.append((pod, attempts))
-                batch = kept
-                if shed_n:
-                    st.shed += shed_n
-                    gov.note_shed(shed_n)
-            batches[t.name] = batch
-            st.attempted = len(batch)
+                # per-TENANT overload governor (sched/overload.py): one
+                # tenant's storm sheds/pauses only that tenant — composing
+                # with the DRF clamp, which bounds a tenant's SHARE while the
+                # governor bounds the control plane's own burn for it
+                gov = s.governor
+                decision = None
+                pop_limit = self.batch_size
+                if gov is not None:
+                    decision = gov.begin_wave(now, s.queue.depths())
+                    if decision.release_deferred:
+                        released = s.queue.release_deferred(now)
+                        if released:
+                            self.telemetry.note_supervisor_event(
+                                "deferred_release",
+                                f"{t.name}: {released} pods re-admitted")
+                    if not decision.dispatch_allowed:
+                        st.commit_paused += 1
+                        batches[t.name] = []
+                        continue
+                    if decision.wave_limit:
+                        pop_limit = min(pop_limit, decision.wave_limit)
+                batch = s.queue.pop_batch(pop_limit, now=now)
+                if decision is not None and decision.shed_below is not None \
+                        and batch:
+                    kept = []
+                    shed_n = 0
+                    for pod, attempts in batch:
+                        if pod.priority < decision.shed_below \
+                                and s.queue.park_deferred(pod, attempts,
+                                                          now=now):
+                            shed_n += 1
+                        else:
+                            kept.append((pod, attempts))
+                    batch = kept
+                    if shed_n:
+                        st.shed += shed_n
+                        gov.note_shed(shed_n)
+                batches[t.name] = batch
+                st.attempted = len(batch)
         span.mark("pump")
 
         from ..sched.supervisor import DispatchAbandonedError
@@ -393,12 +676,13 @@ class FleetServer:
         queue (prompt retry, no failure verdict) — solo-routed and stormed
         tenants' batches are already empty lists here."""
         for t in tlist:
-            st = tick.per_tenant[t.name]
-            for pod, attempts in batches[t.name]:
-                st.aborted += 1
-                st.requeued += 1
-                t.sched.queue.add_prompt_retry(pod, attempts=attempts,
-                                               now=now)
+            with t.ingest_mu:
+                st = tick.per_tenant[t.name]
+                for pod, attempts in batches[t.name]:
+                    st.aborted += 1
+                    st.requeued += 1
+                    t.sched.queue.add_prompt_retry(pod, attempts=attempts,
+                                                   now=now)
 
     def _dispatch_tick(self, tlist, batches, tick, now, span):
         """Everything between the batch pop and the device result: the
@@ -417,34 +701,43 @@ class FleetServer:
         # exactly the cross-tenant interference the fleet forbids) ---- #
         solo_ran = False
         for t in tlist:
-            needs_solo = (snaps[t.name].gang is not None
-                          or snaps[t.name].dims.has_node_name)
-            if not needs_solo or not batches[t.name]:
-                continue
-            s = t.sched
-            for pod, attempts in batches[t.name]:
-                # attempts-1: the fleet pop and the solo wave's own pop are
-                # ONE real attempt — re-adding the post-pop count would let
-                # the solo pop double-increment and escalate a failing
-                # pod's backoff 4x per failure instead of 2x
-                s.queue.add_prompt_retry(pod, attempts=attempts - 1,
-                                         now=now)
-            solo = s.schedule_pending(now)
-            st = tick.per_tenant[t.name]
-            st.scheduled += solo.scheduled
-            st.unschedulable += solo.unschedulable
-            st.bind_errors += solo.bind_errors
-            # aborted/requeued/failed_keys carry through too: a chaos-
-            # injected abandonment inside the solo wave must show up in
-            # THIS tenant's fleet counters (the chaos suite asserts
-            # isolation from these, not from logs)
-            st.aborted += solo.aborted
-            st.requeued += solo.requeued
-            st.failed_keys.extend(solo.failed_keys)
-            st.assignments.update(solo.assignments)
-            tick.dispatches += 1
-            batches[t.name] = []
-            solo_ran = True
+            # the solo wave is this tenant's whole cycle, held under its
+            # ingest lock exactly like SchedulerServer.run_one_wave holds
+            # _mu across schedule_pending — a route handler's multi-step
+            # cache/queue transition must not interleave with the wave's
+            # own mutations. Known tradeoff: the tenant's mux route keeps
+            # buffering meanwhile, so a wave longer than buffer/event-rate
+            # costs that route a bounded, route-local resync (never an
+            # apiserver relist); size `buffer` for the worst solo wave.
+            with t.ingest_mu:
+                needs_solo = (snaps[t.name].gang is not None
+                              or snaps[t.name].dims.has_node_name)
+                if not needs_solo or not batches[t.name]:
+                    continue
+                s = t.sched
+                for pod, attempts in batches[t.name]:
+                    # attempts-1: the fleet pop and the solo wave's own pop are
+                    # ONE real attempt — re-adding the post-pop count would let
+                    # the solo pop double-increment and escalate a failing
+                    # pod's backoff 4x per failure instead of 2x
+                    s.queue.add_prompt_retry(pod, attempts=attempts - 1,
+                                             now=now)
+                solo = s.schedule_pending(now)
+                st = tick.per_tenant[t.name]
+                st.scheduled += solo.scheduled
+                st.unschedulable += solo.unschedulable
+                st.bind_errors += solo.bind_errors
+                # aborted/requeued/failed_keys carry through too: a chaos-
+                # injected abandonment inside the solo wave must show up in
+                # THIS tenant's fleet counters (the chaos suite asserts
+                # isolation from these, not from logs)
+                st.aborted += solo.aborted
+                st.requeued += solo.requeued
+                st.failed_keys.extend(solo.failed_keys)
+                st.assignments.update(solo.assignments)
+                tick.dispatches += 1
+                batches[t.name] = []
+                solo_ran = True
         if solo_ran:
             # the solo waves consumed those batches, mutated their tenants'
             # caches, and may have grown the fleet bucket — re-snapshot
@@ -605,87 +898,88 @@ class FleetServer:
                        np.float32), xp=np)
         tick.drf_violations += int(viol[:len(tlist)].sum())
         for k, t in enumerate(tlist):
-            s = t.sched
-            st = tick.per_tenant[t.name]
-            order = snaps[t.name].node_order
-            cycle = s.queue.current_cycle()
-            # per-TENANT decision provenance (ISSUE 10): slice tenant k's
-            # rows off the stacked attribution and feed ITS explainer —
-            # quota-clamped pods (admitted=False) are excluded: they carry
-            # no verdict this tick, and their zeroed attribution would
-            # render as empty-reason noise
-            if exp is not None and s.explainer is not None \
-                    and batches[t.name]:
-                idx = [i for i in range(len(batches[t.name]))
-                       if admitted[k, i]]
-                if idx:
-                    from ..ops.assign import ExplainResult
+            with t.ingest_mu:  # commit phase vs this tenant's route threads
+                s = t.sched
+                st = tick.per_tenant[t.name]
+                order = snaps[t.name].node_order
+                cycle = s.queue.current_cycle()
+                # per-TENANT decision provenance (ISSUE 10): slice tenant k's
+                # rows off the stacked attribution and feed ITS explainer —
+                # quota-clamped pods (admitted=False) are excluded: they carry
+                # no verdict this tick, and their zeroed attribution would
+                # render as empty-reason noise
+                if exp is not None and s.explainer is not None \
+                        and batches[t.name]:
+                    idx = [i for i in range(len(batches[t.name]))
+                           if admitted[k, i]]
+                    if idx:
+                        from ..ops.assign import ExplainResult
 
-                    sl = ExplainResult(*(np.asarray(a)[k][idx]
-                                         for a in exp))
-                    try:
-                        rec = s.explainer.observe_wave(
-                            [batches[t.name][i] for i in idx],
-                            node[k][idx], sl, order, now=now)
-                    except Exception:  # noqa: BLE001 - provenance must
-                        rec = None     # never take down a tick
-                    if rec:
-                        self.telemetry.note_supervisor_event(
-                            "explain", f"{t.name}: "
-                            f"{rec.get('unschedulable', 0)} attributed")
-            commits: List[Tuple] = []
-            failures: List[Tuple] = []
-            for i, (pod, attempts) in enumerate(batches[t.name]):
-                if not admitted[k, i]:
-                    # quota-clamped, not unschedulable: the pod is fine,
-                    # the tenant's headroom wasn't — defer promptly. The
-                    # clamp count rides CycleStats so observe_fleet_tick
-                    # emits the tenant-labelled DRF_CLAMPED series.
-                    st.requeued += 1
-                    st.drf_clamped += 1
-                    tick.drf_clamped += 1
-                    s.queue.add_prompt_retry(pod, attempts=attempts,
-                                             now=now)
-                    continue
-                ni = int(node[k, i])
-                if ni < 0:
-                    failures.append((pod, attempts))
-                    continue
-                if s.cache.get_pod(pod.key) is not None:
-                    continue  # skipPodSchedule (stale queue entry)
-                if ni >= len(order) or not order[ni]:
-                    # a placement onto a node row outside this tenant's
-                    # own cluster — the inert-row contract broke
-                    tick.cross_tenant_placements += 1
-                    failures.append((pod, attempts))
-                    continue
-                commits.append((pod, order[ni], attempts))
-            try:
-                intent = s._write_intent(cycle, commits)
-            except Exception:  # noqa: BLE001 - ledger storage unavailable
-                for pod, _node, attempts in commits:
-                    st.aborted += 1
-                    st.requeued += 1
-                    s.queue.add_prompt_retry(pod, attempts=attempts,
-                                             now=now)
-                commits = []
-                intent = None
-            for ci, (pod, node_name, attempts) in enumerate(commits):
-                if s.governor is not None and not s.governor.commit_allowed():
-                    # this tenant's breaker opened mid-commit: its
-                    # remaining commits requeue promptly (the other
-                    # tenants' loops are untouched — per-tenant breakers)
-                    for pod2, _n2, attempts2 in commits[ci:]:
+                        sl = ExplainResult(*(np.asarray(a)[k][idx]
+                                             for a in exp))
+                        try:
+                            rec = s.explainer.observe_wave(
+                                [batches[t.name][i] for i in idx],
+                                node[k][idx], sl, order, now=now)
+                        except Exception:  # noqa: BLE001 - provenance must
+                            rec = None     # never take down a tick
+                        if rec:
+                            self.telemetry.note_supervisor_event(
+                                "explain", f"{t.name}: "
+                                f"{rec.get('unschedulable', 0)} attributed")
+                commits: List[Tuple] = []
+                failures: List[Tuple] = []
+                for i, (pod, attempts) in enumerate(batches[t.name]):
+                    if not admitted[k, i]:
+                        # quota-clamped, not unschedulable: the pod is fine,
+                        # the tenant's headroom wasn't — defer promptly. The
+                        # clamp count rides CycleStats so observe_fleet_tick
+                        # emits the tenant-labelled DRF_CLAMPED series.
                         st.requeued += 1
-                        s.queue.add_prompt_retry(pod2, attempts=attempts2,
+                        st.drf_clamped += 1
+                        tick.drf_clamped += 1
+                        s.queue.add_prompt_retry(pod, attempts=attempts,
                                                  now=now)
-                    break
-                s._commit(pod, node_name, attempts, now, cycle, st)
-            s._retire_intent(intent)
-            for pod, attempts in failures:
-                st.unschedulable += 1
-                st.failed_keys.append(pod.key)
-                s.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
+                        continue
+                    ni = int(node[k, i])
+                    if ni < 0:
+                        failures.append((pod, attempts))
+                        continue
+                    if s.cache.get_pod(pod.key) is not None:
+                        continue  # skipPodSchedule (stale queue entry)
+                    if ni >= len(order) or not order[ni]:
+                        # a placement onto a node row outside this tenant's
+                        # own cluster — the inert-row contract broke
+                        tick.cross_tenant_placements += 1
+                        failures.append((pod, attempts))
+                        continue
+                    commits.append((pod, order[ni], attempts))
+                try:
+                    intent = s._write_intent(cycle, commits)
+                except Exception:  # noqa: BLE001 - ledger storage unavailable
+                    for pod, _node, attempts in commits:
+                        st.aborted += 1
+                        st.requeued += 1
+                        s.queue.add_prompt_retry(pod, attempts=attempts,
+                                                 now=now)
+                    commits = []
+                    intent = None
+                for ci, (pod, node_name, attempts) in enumerate(commits):
+                    if s.governor is not None and not s.governor.commit_allowed():
+                        # this tenant's breaker opened mid-commit: its
+                        # remaining commits requeue promptly (the other
+                        # tenants' loops are untouched — per-tenant breakers)
+                        for pod2, _n2, attempts2 in commits[ci:]:
+                            st.requeued += 1
+                            s.queue.add_prompt_retry(pod2, attempts=attempts2,
+                                                     now=now)
+                        break
+                    s._commit(pod, node_name, attempts, now, cycle, st)
+                s._retire_intent(intent)
+                for pod, attempts in failures:
+                    st.unschedulable += 1
+                    st.failed_keys.append(pod.key)
+                    s.queue.add_unschedulable(pod, attempts, now, cycle=cycle)
 
     def _finish_tick(self, tick: FleetTickStats, span=None) -> None:
         from ..sched.metrics import observe_fleet_tick

@@ -135,6 +135,22 @@ FAILED_EVENTS = REG.counter(
     "by the per-wave write budget; re-qualifies next occurrence), error "
     "(write failed past the retry budget), unsinked (no sink attached)",
     labels=("outcome",))
+# ISSUE 13 fleet watch plane (fleet/server.py FleetWatchPlane): how far
+# behind live watch truth each tenant's serving state is. ~0 on a healthy
+# stream (bookmarks refresh it even when the resource is quiet); grows while
+# the mux stream is dead (tenants keep serving from cached state instead of
+# dropping ticks); decays back to ~0 after the revive's resume.
+TENANT_STALENESS = REG.gauge(
+    "tenant_staleness_seconds",
+    "Seconds since the tenant's watch route last heard from upstream "
+    "(event, bookmark, or list)", labels=("tenant",))
+
+
+def observe_tenant_staleness(staleness_by_tenant) -> None:
+    """Export per-tenant watch staleness ({tenant → seconds}) — called from
+    FleetWatchPlane.maintain() every fleet tick."""
+    for name, s in staleness_by_tenant.items():
+        TENANT_STALENESS.set(round(float(s), 3), tenant=name)
 
 
 def observe_fleet_tick(per_tenant) -> None:

@@ -227,6 +227,37 @@ class TelemetryGateway:
         self._httpd.server_close()
 
 
+def pod_schedulable_v1(obj: Obj) -> bool:
+    """Is this v1 pod dict something a scheduler should (still) act on?
+    Shared by SchedulerServer's informer handlers and the fleet watch
+    plane's per-tenant ingest (fleet/server._TenantIngest) — ONE
+    definition, so the two paths cannot drift."""
+    phase = obj.get("status", {}).get("phase", "")
+    return phase not in ("Succeeded", "Failed") and \
+        not meta.is_being_deleted(obj)
+
+
+def apply_pod_update_v1(scheduler: Scheduler, old: Obj, new: Obj,
+                        to_pod) -> None:
+    """The informer pod-UPDATE transition (eventhandlers.go:335-441),
+    against one Scheduler: a no-longer-schedulable pod either frees its
+    node's resources (terminated on a node) or leaves the queue; a live
+    one flows through on_pod_update. `to_pod` is the caller's v1→Pod
+    conversion (it owns creation_index stamping). Callers provide their
+    own locking. Shared by SchedulerServer and _TenantIngest."""
+    if not pod_schedulable_v1(new):
+        p = pod_from_v1(new)
+        if p.node_name:
+            # terminated on its node: free the resources
+            if scheduler.cache.get_pod(p.key) is not None:
+                scheduler.cache.remove_pod(p.key)
+                scheduler.queue.move_all_to_active(scheduler.clock())
+        else:
+            scheduler.queue.delete(p.key)
+        return
+    scheduler.on_pod_update(pod_from_v1(old), to_pod(new))
+
+
 def restrict_pod_nodes(pod: Pod, allowed: frozenset) -> Pod:
     """AND a node-name restriction into the pod's required node affinity by
     adding matchFields(metadata.name IN allowed) to every term (or one fresh
@@ -414,9 +445,7 @@ class SchedulerServer:
 
     @staticmethod
     def _schedulable(obj: Obj) -> bool:
-        phase = obj.get("status", {}).get("phase", "")
-        return phase not in ("Succeeded", "Failed") and \
-            not meta.is_being_deleted(obj)
+        return pod_schedulable_v1(obj)
 
     # -- event handlers (eventhandlers.go:335-441) --------------------------- #
 
@@ -428,18 +457,7 @@ class SchedulerServer:
 
     def _on_pod_update(self, old: Obj, new: Obj) -> None:
         with self._mu:
-            if not self._schedulable(new):
-                p = pod_from_v1(new)
-                if p.node_name:
-                    # terminated on its node: free the resources
-                    if self.scheduler.cache.get_pod(p.key) is not None:
-                        self.scheduler.cache.remove_pod(p.key)
-                        self.scheduler.queue.move_all_to_active(
-                            self.scheduler.clock())
-                else:
-                    self.scheduler.queue.delete(p.key)
-                return
-            self.scheduler.on_pod_update(pod_from_v1(old), self._to_pod(new))
+            apply_pod_update_v1(self.scheduler, old, new, self._to_pod)
 
     def _on_pod_delete(self, obj: Obj) -> None:
         with self._mu:

@@ -68,6 +68,37 @@ Seams wired in this repo (fault name → injection point):
                                               `except Exception` guard the
                                               way SIGKILL punches through a
                                               process (restart drills)
+    watch.stall@<route>                       client/watchmux.py (site =
+                                              route/tenant name): ONE mux
+                                              route's consumer goes deaf —
+                                              that route is broken (queue
+                                              cleared, sequence fence
+                                              raised) and resyncs itself
+                                              from the mux's indexer
+                                              snapshot; the apiserver and
+                                              sibling routes never notice
+    watch.compact@floor                       storage/store.py dispatch
+                                              pump: a REAL compaction at
+                                              the current revision, with
+                                              the compaction-boundary
+                                              BOOKMARK broadcast — live
+                                              opted-in streams stay
+                                              resumable, stale resume
+                                              tokens beneath the floor
+                                              earn genuine 410s
+    mux.die@<mux>|stream                      client/watchmux.py event fan:
+                                              the mux's ONE upstream
+                                              stream dies; tenants serve
+                                              cached state (staleness
+                                              grows) until
+                                              FleetWatchPlane.maintain
+                                              revives it — a RESUME from
+                                              the last bookmarked RV, not
+                                              K relists. Site = the mux
+                                              name (pods/nodes) for a
+                                              deterministic single-mux
+                                              kill; "stream" is the
+                                              shared any-mux site
     tenant.storm                              fleet/server.py per-tenant
                                               tick (site = tenant name,
                                               e.g. "tenant.storm@t02:1+"):

@@ -661,10 +661,16 @@ class TestDisruptionAndQuota:
             "spec": {"containers": [{
                 "name": "c", "image": "i",
                 "resources": {"requests": {"cpu": "500m"}}}]}})
+        # wait on BOTH fields: the controller is level-triggered and
+        # self-healing, so a sync racing the pod informer's initial
+        # replace can transiently overwrite a good status with an
+        # empty-lister recompute — a point-in-time read between the two
+        # writes flakes (pods "1" then cpu "0")
         assert wait_for(lambda: client.resourcequotas.get("q")
                         .get("status", {}).get("used", {}).get("pods") == "1")
-        used = client.resourcequotas.get("q")["status"]["used"]
-        assert used["requests.cpu"] == "500m"
+        assert wait_for(lambda: client.resourcequotas.get("q")
+                        .get("status", {}).get("used", {})
+                        .get("requests.cpu") == "500m")
 
 
 class TestCronJob:
