@@ -224,12 +224,20 @@ METRIC_BUDGETS = {
     # the untelemetered throughput, and the e2e histogram must actually
     # have fired (a silent tracker would pass every latency bound at 0).
     # measured baseline (CPU, 2000 ev/s @ 1000×10k; span includes the
-    # binding wave itself): p50 ~45-55 ms, p99 ~235-550 ms — bounds
-    # leave ~10× for loaded CI boxes; item 2 will ratchet them
-    ("latency", 1000): {"p50_ms": ("<=", 2500.0),
-                        "p99_ms": ("<=", 5000.0),
+    # binding wave itself): pre-micro-wave baseline (BENCH_r06) p50 67 ms
+    # / p99 416 ms. ISSUE 18 ratchet: the churn now runs with streaming
+    # micro-waves ON (KTPU_MICROWAVE), so the bounds tighten 4× from the
+    # old 2500/5000 — still leaving loaded-CI headroom over the measured
+    # numbers. micro_waves proves the streaming path actually carried the
+    # churn (the latency claim must never pass via bulk waves on a fast
+    # box); microwave_bit_equal proves the KTPU_MICROWAVE=0 kill switch
+    # reproduces the micro run's placements exactly.
+    ("latency", 1000): {"p50_ms": ("<=", 625.0),
+                        "p99_ms": ("<=", 1250.0),
                         "telemetry_overhead_pct": ("<=", 2.0),
                         "e2e_recorded": (">=", 1),
+                        "micro_waves": (">=", 1),
+                        "microwave_bit_equal": (">=", 1),
                         "lost_pods": ("<=", 0)},
     # ISSUE 9 acceptance: the storm loses nothing and double-binds
     # nothing; high-priority p99 stays bounded WHILE the storm (and the
@@ -1725,18 +1733,90 @@ def _classes_stage(n_nodes, n_pods):
     }))
 
 
+class _TimedSpan:
+    """Wave-span proxy for `_instrument_telemetry`: times each phase
+    `mark` into the shared accumulator, forwards everything else. The
+    scheduler passes its span object back as the `note_device_split`
+    token and into `finish_wave`, so the proxy (not the inner span) must
+    be the identity the scheduler holds."""
+
+    __slots__ = ("_span", "_acc")
+
+    def __init__(self, span, acc):
+        self._span = span
+        self._acc = acc
+
+    @property
+    def enabled(self):
+        return self._span.enabled
+
+    @property
+    def trace(self):
+        return self._span.trace
+
+    def mark(self, name):
+        t0 = time.perf_counter()
+        self._span.mark(name)
+        self._acc["s"] += time.perf_counter() - t0
+
+    def phases(self):
+        return self._span.phases()
+
+
+def _instrument_telemetry(tel):
+    """Wrap every telemetry entry point that runs inside a serving wave
+    with a perf_counter bracket; returns the accumulator dict whose "s"
+    key collects total telemetry self-time (seconds). The wrapping cost
+    itself lands inside the bracket, so the measurement is conservative
+    (it can only over-report). See the latency stage's phase-2 comment
+    for why this replaces the on/off throughput ratio as the gated
+    telemetry-overhead estimator."""
+    acc = {"s": 0.0}
+
+    def timed(fn):
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                acc["s"] += time.perf_counter() - t0
+        return wrapper
+
+    tel.record_bound = timed(tel.record_bound)
+    tel.record_bound_many = timed(tel.record_bound_many)
+    tel.finish_wave = timed(tel.finish_wave)
+    tel.note_supervisor_event = timed(tel.note_supervisor_event)
+    tel.note_device_split = timed(tel.note_device_split)
+    inner_wave_span = tel.wave_span
+
+    def wave_span(name="wave"):
+        t0 = time.perf_counter()
+        span = inner_wave_span(name)
+        acc["s"] += time.perf_counter() - t0
+        return _TimedSpan(span, acc)
+
+    tel.wave_span = wave_span
+    return acc
+
+
 def _latency_stage(n_nodes, n_pods):
     """ISSUE 7 acceptance stage: per-pod watch→bind e2e latency under a
     DETERMINISTIC churn generator — pods (deterministic names/shapes) are
     injected against the resident scheduler at a sustained, configurable
     rate (KTPU_LATENCY_EVENTS_PER_S, default 2000), bound pods complete and
     leave, and every pod's ingest→Binding span lands in the
-    scheduler_pod_e2e_latency_seconds histogram (sched/telemetry.py). Emits
-    exact p50_ms/p99_ms from the telemetry reservoir — the pre-micro-wave
-    BASELINE ROADMAP item 2's p99<100ms target will be judged against —
-    plus telemetry_overhead_pct: the same drain-to-idle throughput measured
-    with KTPU_TELEMETRY on vs off (budget: within 2%). The flight-recorder
-    ring dumps to the FLIGHT_OUT artifact (same contract as BENCH_OUT)."""
+    scheduler_pod_e2e_latency_seconds histogram (sched/telemetry.py). The
+    churn scheduler runs with streaming micro-waves ON (ISSUE 18,
+    KTPU_MICROWAVE) — fresh deltas admit sub-cycle instead of waiting out
+    a bulk cadence — so the exact p50_ms/p99_ms it emits are the numbers
+    ROADMAP item 2's p99<100ms target is judged against (pre-micro
+    baseline: BENCH_r06 p50 67 ms / p99 416 ms on this box). Also emits
+    telemetry_overhead_pct: the fraction of wave time spent inside
+    the telemetry layer, measured DIRECTLY via self-time accounting
+    (budget: within 2%; see the phase-2 comment for why a paired on/off
+    throughput ratio cannot gate this on a shared box). The
+    flight-recorder ring dumps to the FLIGHT_OUT artifact (same contract
+    as BENCH_OUT)."""
     import jax
 
     from kubernetes_tpu.api.types import Pod, Resources
@@ -1749,10 +1829,10 @@ def _latency_stage(n_nodes, n_pods):
                 E=bucket(2 * batch + 256))
     nodes = make_nodes(n_nodes)
 
-    def mk(telemetry_on):
+    def mk(telemetry_on, micro=False):
         os.environ["KTPU_TELEMETRY"] = "1" if telemetry_on else "0"
         s = Scheduler(binder=RecordingBinder(), batch_size=batch,
-                      base_dims=base)
+                      base_dims=base, microwave=micro)
         # the prewarmer would background-compile during measured waves
         # (the growth stage owns that scenario)
         s.prewarmer.enabled = False
@@ -1798,11 +1878,26 @@ def _latency_stage(n_nodes, n_pods):
         full = [(sec, n) for sec, n in waves if n >= batch // 2]
         return max((n / sec for sec, n in (full or waves)), default=0.0)
 
-    # ---- warmup: pay the engine compile outside every measured window --- #
-    s_on = mk(True)
+    # ---- warmup: pay the engine compiles outside every measured window.
+    # The churn scheduler runs with streaming micro-waves ON (ISSUE 18),
+    # which adds a SECOND compile signature (the fixed micro-P graft) —
+    # warm both: a batch-deep drain compiles the bulk program, then a
+    # trickle of fresh deltas compiles the micro program. ---- #
+    s_on = mk(True, micro=True)
     drain(s_on, "warm", batch)
+    drain(s_on, "warm-micro", 8)
+    # ... and the patch-scatter ladder: every dirty-row bucket's
+    # `_patch_rows` specialization (state/cache.py warm_patch_ladder).
+    # Churn patches walk the bucket ladder as wave sizes vary, and a
+    # first-seen rung is a ~0.5 s synchronous compile — a p99 outlier
+    # that measures XLA, not the scheduler. The prewarmer is disabled
+    # here, so warm synchronously (production gets the same ladder via
+    # prewarmer.ensure_patch_ladder off the bulk cadence).
+    s_on.cache.warm_patch_ladder(
+        s_on.cache.snapshot(s_on.encoder, [], base))
+    micro_warmed = s_on.micro_waves
 
-    # ---- phase 1: the latency churn (telemetry ON) -------------------- #
+    # ---- phase 1: the latency churn (telemetry ON, micro-waves ON) ---- #
     s_on.telemetry.latency_samples.clear()
     rate = float(os.environ.get("KTPU_LATENCY_EVENTS_PER_S", "2000"))
     n_events = n_pods
@@ -1829,24 +1924,86 @@ def _latency_stage(n_nodes, n_pods):
             break  # safety: the budgets will flag the truncated numbers
     t_churn = time.monotonic() - t_start
     bound_churn = len(s_on.binder.bound) - bound_before
+    micro_churn = s_on.micro_waves - micro_warmed
     q = s_on.telemetry.latency_quantiles((0.5, 0.99))
     lost = n_events - bound_churn - sum(s_on.queue.lengths())
 
-    # ---- phase 2: telemetry overhead (drain-to-idle, on vs off) ------- #
-    # INTERLEAVED rounds (off, on, off, on): box-load drift over the
-    # measurement window hits both modes symmetrically instead of landing
-    # entirely on whichever mode ran second; best-of-waves then compares
-    # each mode's least-disturbed wave
+    # ---- phase 2: telemetry overhead (direct self-time accounting) ---- #
+    # DEFLAKED (re-anchor note: a 6.43% reading on an UNMODIFIED head
+    # breached the 2% budget purely environmentally). The old estimator —
+    # drain-to-idle throughput with KTPU_TELEMETRY on vs off, overhead =
+    # 1 - pps_on/pps_off — cannot resolve a ≤2% budget on a shared box:
+    # a control experiment timing IDENTICAL back-to-back waves (same
+    # scheduler, same mode, GC collected and disabled, adjacent in time)
+    # measured per-pair wave-time ratios of 0.72–1.46 with a median of
+    # 0.94, i.e. the ratio estimator reports −6%..+15% "overhead" on
+    # literally unchanged code. Two separately-constructed Scheduler
+    # instances additionally differ by a persistent ±5% (allocation
+    # layout), which pairing cannot cancel either. No arrangement of
+    # rounds/medians/minima fixes an estimator whose per-sample noise is
+    # 10× the budget it gates.
+    #
+    # The deflaked estimator measures the NUMERATOR directly instead:
+    # every telemetry entry point that runs inside a wave (wave_span's
+    # phase marks, record_bound/record_bound_many, finish_wave,
+    # note_supervisor_event) is wrapped with a perf_counter bracket, the
+    # self-time accumulates across k drain rounds, and
+    #   overhead_pct = 100 × telemetry_self_s / total_wave_s.
+    # Box noise now scales numerator and denominator together (the
+    # estimate is ~1% ± 0.1% instead of 1% ± 15%), the wrapping cost
+    # (~1.5 µs/wave, two perf_counter calls per wrapped entry) lands
+    # INSIDE the measured self-time so the reading is conservative, and
+    # second-order effects (cache pressure from telemetry allocations)
+    # are the only unmeasured residue. The on/off throughput pair is
+    # still reported — informationally — for eyeballing across runs.
+    k_rounds = max(2, int(os.environ.get("KTPU_OVERHEAD_ROUNDS", "3")))
+    tel_self = _instrument_telemetry(s_on.telemetry)
+    ovh_waves = []
+    for rnd in range(k_rounds):
+        ovh_waves.extend(drain(s_on, f"ovh{rnd}", n_pods))
+    wave_s = sum(sec for sec, _ in ovh_waves)
+    overhead_pct = 100.0 * tel_self["s"] / max(wave_s, 1e-9)
+    pps_on = best_pps(ovh_waves)
+
+    # informational on/off pair (NOT the gated number — see above)
     s_off = mk(False)
     drain(s_off, "warm-off", batch)   # its own (compile-cached) warm wave
-    waves_on, waves_off = [], []
-    for rnd in range(2):
-        waves_off += drain(s_off, f"ovh-off{rnd}", n_pods)
-        waves_on += drain(s_on, f"ovh-on{rnd}", n_pods)
+    pps_off = best_pps(drain(s_off, "ovh-off", n_pods))
+
+    # ---- phase 3: KTPU_MICROWAVE kill-switch bit-equality (ISSUE 18) ---
+    # The guardrail the tentpole rides on: identical watch input through
+    # the micro path (fresh-delta rounds admit via micro-waves, the deep
+    # round arbitrates back to bulk) and through the bulk-only pipeline
+    # must produce IDENTICAL placements. Rounds are sized to cross the
+    # arbitration boundary both ways: micro, micro, bulk (>128), micro.
+    def _bit_run(micro):
+        os.environ["KTPU_TELEMETRY"] = "0"
+        s = Scheduler(binder=RecordingBinder(), batch_size=batch,
+                      base_dims=base, microwave=micro)
+        s.prewarmer.enabled = False
+        for n in nodes:
+            s.on_node_add(n)
+        got = {}
+        i = 0
+        for count in (5, 32, 130, 7):
+            for _ in range(count):
+                s.on_pod_add(mkpod("bit", i))
+                i += 1
+            got.update(s.schedule_pending().assignments)
+        for _ in range(8):   # drain any arbitration remainder
+            st = s.schedule_pending()
+            got.update(st.assignments)
+            if not st.attempted:
+                break
+        return got, s.micro_waves
+
+    bit_micro, bit_micro_waves = _bit_run(True)
+    bit_bulk, bit_bulk_waves = _bit_run(False)
+    microwave_bit_equal = 1 if (bit_micro == bit_bulk
+                                and len(bit_micro) == 174
+                                and bit_micro_waves >= 1
+                                and bit_bulk_waves == 0) else 0
     os.environ.pop("KTPU_TELEMETRY", None)
-    pps_on, pps_off = best_pps(waves_on), best_pps(waves_off)
-    overhead_pct = max(0.0, (pps_off - pps_on) / pps_off * 100.0) \
-        if pps_off else 0.0
 
     # ---- flight recorder → FLIGHT_OUT artifact ------------------------ #
     from kubernetes_tpu.sched.metrics import POD_E2E_LATENCY
@@ -1872,7 +2029,17 @@ def _latency_stage(n_nodes, n_pods):
         "churn_pods_per_sec": round(bound_churn / t_churn, 1)
         if t_churn else 0.0,
         "telemetry_overhead_pct": round(overhead_pct, 2),
+        "overhead_mode": "direct-self-time",
+        "overhead_rounds": k_rounds,
+        "overhead_self_s": round(tel_self["s"], 4),
+        "overhead_wave_s": round(wave_s, 4),
         "pods_per_sec_telemetry_off": round(pps_off, 1),
+        # ISSUE 18 streaming micro-waves: how many of the churn's waves
+        # were micro admissions (budget ≥1: the latency claim must have
+        # ridden the streaming path), and the kill-switch proof —
+        # KTPU_MICROWAVE=0 placements bit-equal to the micro run's
+        "micro_waves": micro_churn,
+        "microwave_bit_equal": microwave_bit_equal,
         "lost_pods": lost,
         "flight_out": (os.path.basename(flight_path) if wrote
                        else f"WRITE FAILED: {os.path.basename(flight_path)}"),
@@ -2798,6 +2965,10 @@ def main():
             cs = r.get("cycle_seconds")
             r["within_budget"] = cs is not None and cs <= budget
         r.setdefault("metric_breaches", []).extend(_check_metric_budgets(r))
+        # every stage record carries the backend it measured on: the trend
+        # gate (scripts/bench_trend.py) must not read a cpu-run's wave
+        # times against a tpu-run's as a regression
+        r.setdefault("backend", backend)
         results.append(r)
         print(f"# stage {n_nodes}x{n_pods} {kind}: "
               + (f"{r['pods_per_sec']} pods/s "

@@ -142,6 +142,30 @@ class Histogram(_Metric):
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._totals[k] = self._totals.get(k, 0) + 1
 
+    def observe_many(self, values: Sequence[float], **labels) -> None:
+        """Batch observe: one lock acquisition (and one dict resolve) for a
+        whole wave's samples. The e2e latency histogram fires once per
+        Binding — thousands of times per bulk wave, and the micro-wave
+        regime multiplies the wave count on top — and the per-call
+        lock+lookup overhead of `observe` was a measurable slice of the
+        ≤2% telemetry budget at that rate."""
+        if not values:
+            return
+        bl = self.buckets
+        nb = len(bl)
+        bis = bisect.bisect_left
+        with self._mu:
+            k = self._key(labels)
+            counts = self._counts.setdefault(k, [0] * nb)
+            s = 0.0
+            for v in values:
+                i = bis(bl, v)
+                if i < nb:
+                    counts[i] += 1
+                s += v
+            self._sums[k] = self._sums.get(k, 0.0) + s
+            self._totals[k] = self._totals.get(k, 0) + len(values)
+
     def count(self, **labels) -> int:
         with self._mu:
             return self._totals.get(self._key(labels), 0)

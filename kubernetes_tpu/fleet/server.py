@@ -535,6 +535,45 @@ class FleetServer:
             self._fleet_dims = union
         raise RuntimeError("fleet bucket did not converge in 4 passes")
 
+    def micro_pass(self, now: Optional[float] = None,
+                   tick: Optional[FleetTickStats] = None
+                   ) -> Dict[str, CycleStats]:
+        """Streaming micro-admission across the fleet (ISSUE 18): each
+        micro-ready tenant admits its fresh-delta lane through ITS OWN
+        scheduler — own snapshot, own governor/breaker, own ledger
+        namespace — under its ingest lock, so per-tenant isolation is
+        structural, not asserted. Tenants with mixed/deep/empty backlogs
+        are untouched; those pods ride the stacked bulk dispatch.
+
+        Rides the top of every tick; a server loop may ALSO call it
+        between ticks for sub-tick admission latency. When `tick` is
+        given, each tenant's micro outcome is merged into its per-tenant
+        stats so the tenant-labelled metrics (TENANT_ADMITTED et al.)
+        and the flight-recorder fleet record count streamed admissions."""
+        now = self.clock() if now is None else now
+        out: Dict[str, CycleStats] = {}
+        for t in list(self.tenants.values()):
+            if not t.sched.microwave:
+                continue
+            with t.ingest_mu:
+                st = t.sched.schedule_micro(now)
+            if not st.micro:
+                continue
+            out[t.name] = st
+            agg = tick.per_tenant.get(t.name) if tick is not None else None
+            if agg is not None:
+                agg.attempted += st.attempted
+                agg.scheduled += st.scheduled
+                agg.unschedulable += st.unschedulable
+                agg.bind_errors += st.bind_errors
+                agg.aborted += st.aborted
+                agg.requeued += st.requeued
+                agg.shed += st.shed
+                agg.micro += st.micro
+                agg.assignments.update(st.assignments)
+                agg.failed_keys.extend(st.failed_keys)
+        return out
+
     def tick(self, now: Optional[float] = None) -> FleetTickStats:
         now = self.clock() if now is None else now
         t0 = time.perf_counter()
@@ -545,6 +584,11 @@ class FleetServer:
         for t in tlist:
             tick.per_tenant[t.name] = CycleStats()
         span = self.telemetry.wave_span("fleet-tick")
+        # streaming micro-admission interleave (ISSUE 18) before the
+        # stacked bulk dispatch — no-op for every tenant unless its
+        # scheduler opted in (KTPU_MICROWAVE) and its lane is micro-ready
+        if self.micro_pass(now, tick=tick):
+            span.mark("micro")
         if self.watch_plane is not None:
             # watch-plane upkeep rides the tick: staleness export first
             # (a dead stream's tick records HOW stale it served), then the
@@ -614,7 +658,8 @@ class FleetServer:
                         st.shed += shed_n
                         gov.note_shed(shed_n)
                 batches[t.name] = batch
-                st.attempted = len(batch)
+                # += : a micro_pass admission above already counted here
+                st.attempted += len(batch)
         span.mark("pump")
 
         from ..sched.supervisor import DispatchAbandonedError
@@ -964,6 +1009,7 @@ class FleetServer:
                                                  now=now)
                     commits = []
                     intent = None
+                bound_keys: List[str] = []
                 for ci, (pod, node_name, attempts) in enumerate(commits):
                     if s.governor is not None and not s.governor.commit_allowed():
                         # this tenant's breaker opened mid-commit: its
@@ -974,7 +1020,12 @@ class FleetServer:
                             s.queue.add_prompt_retry(pod2, attempts=attempts2,
                                                      now=now)
                         break
-                    s._commit(pod, node_name, attempts, now, cycle, st)
+                    s._commit(pod, node_name, attempts, now, cycle, st,
+                              latency_keys=bound_keys)
+                # one batched span-close per tenant per tick (the scalar
+                # per-pod path was most of the measured telemetry cost)
+                if bound_keys:
+                    s.telemetry.record_bound_many(bound_keys, s.clock())
                 s._retire_intent(intent)
                 for pod, attempts in failures:
                     st.unschedulable += 1

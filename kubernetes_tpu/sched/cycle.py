@@ -41,11 +41,37 @@ def snapshot_with_keys(cache, encoder: Encoder, pending, base_dims,
     snap = cache.snapshot(encoder, pending, base_dims,
                           extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
                           device=device, mesh=mesh)
+    return snap, _taint_scalars(encoder, device, mesh)
+
+
+def micro_snapshot_with_keys(cache, encoder: Encoder, pending, base_dims,
+                             micro_p: int, device=None, mesh=None):
+    """Micro-wave snapshot (ISSUE 18): bring the RESIDENT cluster state
+    current through the ordinary generation-diffed snapshot — with an
+    EMPTY pending batch, so node/existing-pod deltas ride the same
+    patch/donation machinery as a bulk wave — then graft a small
+    standalone [micro_p] pending block holding just the watch-delta pods
+    (state/cache.py micro_graft). The pods are interned FIRST so any
+    registry/capacity growth they cause lands in the base snapshot's
+    dims/tables before the graft reads them. Flipping micro↔bulk changes
+    only the pending identity signature, so each direction's first
+    snapshot after a flip rebuilds one pending block and nothing else."""
+    encoder.intern_pods(pending)
+    base = cache.snapshot(encoder, [], base_dims,
+                          extra_intern=(UNSCHEDULABLE_TAINT_KEY,),
+                          device=device, mesh=mesh)
+    snap = cache.micro_graft(encoder, pending, base, micro_p,
+                             device=device, mesh=mesh)
+    return snap, _taint_scalars(encoder, device, mesh)
+
+
+def _taint_scalars(encoder: Encoder, device, mesh):
+    """The interned synthetic-taint scalar pair every dispatch carries.
+    The scalars are created ON the routed placement — a jnp constructor
+    on the default (possibly dead) backend is exactly what degraded mode
+    must never touch, and a single-device scalar next to mesh-resident
+    tables would force GSPMD to re-commit it every dispatch."""
     encoder.vocabs.label_vals.intern("")
-    # the scalars are created ON the routed placement — a jnp constructor
-    # on the default (possibly dead) backend is exactly what degraded mode
-    # must never touch, and a single-device scalar next to mesh-resident
-    # tables would force GSPMD to re-commit it every dispatch
     import contextlib
 
     if mesh is not None:
@@ -56,13 +82,13 @@ def snapshot_with_keys(cache, encoder: Encoder, pending, base_dims,
             jnp.int32(encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY)),
             rep)
         ev = jax.device_put(jnp.int32(encoder.vocabs.label_vals.get("")), rep)
-        return snap, (uk, ev)
+        return uk, ev
     ctx = jax.default_device(device) if device is not None \
         else contextlib.nullcontext()
     with ctx:
         uk = jnp.int32(encoder.vocabs.label_keys.get(UNSCHEDULABLE_TAINT_KEY))
         ev = jnp.int32(encoder.vocabs.label_vals.get(""))
-    return snap, (uk, ev)
+    return uk, ev
 
 
 def _engine() -> str:

@@ -69,16 +69,28 @@ DRF_CLAMPED = REG.counter(
     "Pending pods clamped inert by the DRF quota pre-mask",
     labels=("tenant",))
 # ISSUE 7 flight-recorder + e2e latency (sched/telemetry.py): the per-pod
-# watch→bind histogram ROADMAP item 2's p99 target is defined in. Buckets
-# are finer than the default ladder below 250 ms — that is where the
-# micro-wave work will live — and extend to 60 s so today's cycle-granular
-# baseline still lands inside a bounded bucket.
+# watch→bind histogram ROADMAP item 2's p99 target is defined in. With
+# streaming micro-waves (ISSUE 18) the operating regime is sub-100 ms, so
+# the ladder is densest from 5–100 ms (where the micro p50/p99 live —
+# roughly one bucket per 1.3–1.5× step, enough to read a p99 shift of
+# tens of ms straight off /metrics) and still extends to 60 s so a
+# brownout's cycle-granular latencies land inside a bounded bucket.
 POD_E2E_LATENCY = REG.histogram(
     "scheduler_pod_e2e_latency_seconds",
     "Per-pod end-to-end latency: informer ingest / queue add (first seen, "
     "surviving requeues) to Binding commit",
-    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15,
-             0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+    buckets=(0.001, 0.0025, 0.005, 0.0075, 0.01, 0.015, 0.02, 0.03, 0.04,
+             0.05, 0.065, 0.08, 0.1, 0.15, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0, 60.0))
+# ISSUE 18 streaming micro-waves (sched/scheduler.py): how many waves were
+# micro admissions — small fresh-delta batches grafted onto the resident
+# snapshot between bulk cycles. Ratio against wave counts elsewhere tells
+# whether the streaming path is actually carrying the watch traffic.
+MICRO_WAVES = REG.counter(
+    "scheduler_micro_waves_total",
+    "Micro-waves dispatched (streaming sub-cycle admission of fresh watch "
+    "deltas; bulk backlog waves are not counted)",
+    labels=("scheduler",))
 FLIGHT_DUMPS = REG.counter(
     "scheduler_flight_recorder_dumps_total",
     "Flight-recorder ring dumps, by trigger (abandoned, watchdog_timeout, "

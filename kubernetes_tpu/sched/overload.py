@@ -401,18 +401,26 @@ class OverloadGovernor:
         self._last_depth, self._last_t, self._consumed = depth, now, 0
 
     def end_wave(self, now: float, attempted: int,
-                 cycle_seconds: float) -> None:
+                 cycle_seconds: float, micro: bool = False) -> None:
         """Deadline-streak tracking + adaptive wave sizing. Sizing only
         acts while BROWNED OUT (mode != NORMAL): in NORMAL the governor is
         a pure observer, so healthy runs stay bit-equal to the pre-
         governor pipeline. Limits move on the power-of-two ladder the
         Dims bucketing compiles, so a grown-back wave lands on a bucket
         signature that is already warm (shrunk waves stay inside the
-        P-floored bucket — no recompile in either direction)."""
+        P-floored bucket — no recompile in either direction).
+
+        `micro=True` (ISSUE 18 micro-waves) feeds the ingest estimate —
+        micro-consumed pods are real consumption — but is FENCED OUT of
+        the deadline streak and the sizer: a micro wave is sub-cycle by
+        construction, so its timing says nothing about whether BULK waves
+        meet the deadline; letting it clear the slow streak (or bank
+        healthy-wave credit) would mask a bulk brownout behind a stream
+        of fast micro admissions."""
         del now  # symmetry with begin_wave; sizing is wave-count paced
         self._consumed += attempted
         cfg = self.cfg
-        if attempted == 0:
+        if attempted == 0 or micro:
             return
         slow = cycle_seconds > cfg.target_cycle_s
         self._slow_streak = self._slow_streak + 1 if slow else 0

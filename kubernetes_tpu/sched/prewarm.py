@@ -397,6 +397,45 @@ class BucketPrewarmer:
                 return False
         return self.rewarm(d, engine, extras, gang, mesh, rc, fleet)
 
+    def ensure_patch_ladder(self, cache, snap, mesh=None) -> bool:
+        """Background compile-ahead for the resident patch-scatter ladder
+        (state/cache.py warm_patch_ladder): the per-bucket `_patch_rows`
+        specializations the incremental snapshot path dispatches. Bulk
+        waves amortize a first-seen rung's compile across thousands of
+        pods; a streaming micro-wave (ISSUE 18) cannot — a 3-pod
+        admission stalling ~0.5 s on a fresh rung IS the p99. Keyed by
+        plane shapes, so a capacity growth re-warms the new ladder.
+        Returns True when a compile pass was scheduled."""
+        if not self.enabled or snap is None \
+                or max(snap.dims.N, snap.dims.E) < self.min_axis:
+            return False
+        key = ("patch-ladder", snap.dims.N, snap.dims.E, snap.dims.P,
+               self._mesh_sig(mesh))
+        with self._mu:
+            if key in self._warmed:
+                return False
+            if self._inflight is not None and self._inflight.is_alive():
+                return False  # one compile at a time; retry next cycle
+            self._warmed.add(key)
+
+            def _run():
+                try:
+                    cache.warm_patch_ladder(snap, mesh=mesh)
+                except Exception as e:  # noqa: BLE001 - warm is an
+                    # optimization (see _compile); backend-loss-shaped
+                    # failures still reach the supervisor
+                    with self._mu:
+                        self._warmed.discard(key)
+                    if self.supervisor is not None:
+                        self.supervisor.note_compile_failure(e)
+
+            t = threading.Thread(target=_run, daemon=True,
+                                 name=f"ktpu-prewarm-ladder-{snap.dims.N}"
+                                      f"x{snap.dims.E}")
+            t.start()
+            self._inflight = t
+        return True
+
     # ---- preemption-burst program (sched/preemption.py _preempt) ---- #
 
     @classmethod

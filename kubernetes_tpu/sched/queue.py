@@ -86,6 +86,16 @@ class PriorityQueue:
         # deferred under overload — never dropped, never failed; released
         # in one batch when the brownout ends (plus pump()'s safety flush)
         self._deferred: Dict[str, _Entry] = {}
+        # micro-eligible lane (ISSUE 18 streaming micro-waves): an
+        # insertion-ordered SUBSET VIEW over activeQ entries that arrived
+        # via fresh watch deltas (add/update) and can be admitted by a
+        # small sub-cycle wave — no gang membership (a gang quorum is a
+        # bulk-wave concern) and no spec.nodeName (that reroutes the wave
+        # to the scan engine). Entries here are ALSO in _active_keys;
+        # pop_batch draining a pod evicts its view entry, so with
+        # micro-waves disabled the lane is pure passive bookkeeping and
+        # the bulk pipeline is byte-for-byte unchanged.
+        self._micro: Dict[str, _Entry] = {}
         self._nominated: Dict[str, str] = {}  # pod key -> nominated node name
         # schedulingCycle / moveRequestCycle (scheduling_queue.go:139-147):
         # if a move request happened at-or-after the cycle a pod was popped in,
@@ -98,6 +108,7 @@ class PriorityQueue:
     # ------------------------------------------------------------------ #
 
     def _delete_everywhere(self, key: str) -> Optional[_Entry]:
+        self._micro.pop(key, None)
         e = self._active_keys.pop(key, None)
         if e is None:
             e = self._backoff_keys.pop(key, None)
@@ -107,6 +118,10 @@ class PriorityQueue:
             e = self._deferred.pop(key, None)
         # heap entries are lazily discarded at pop time via the key maps
         return e
+
+    @staticmethod
+    def _micro_eligible(pod: Pod) -> bool:
+        return not pod.pod_group and not pod.node_name
 
     def _push_active(self, e: _Entry) -> None:
         k = _active_key(e)
@@ -124,11 +139,18 @@ class PriorityQueue:
             self.tracker.stamp(key, now)
 
     def add(self, pod: Pod, now: float = 0.0) -> None:
-        """Add a new pending pod straight to activeQ."""
+        """Add a new pending pod straight to activeQ. Fresh watch-delta
+        admissions are the micro-wave feedstock: eligible pods land in the
+        micro view too (requeue paths deliberately do not — a pod with
+        scheduling history belongs to the bulk pipeline's backoff/fairness
+        machinery)."""
         with self._mu:
             self._stamp(pod.key, now)
             self._delete_everywhere(pod.key)
-            self._push_active(_Entry(pod=pod, timestamp=now))
+            e = _Entry(pod=pod, timestamp=now)
+            self._push_active(e)
+            if self._micro_eligible(pod):
+                self._micro[pod.key] = e
 
     def add_unschedulable(
         self, pod: Pod, attempts: int, now: float, cycle: Optional[int] = None
@@ -172,9 +194,14 @@ class PriorityQueue:
         so it moves to activeQ."""
         with self._mu:
             self._stamp(pod.key, now)
-            e = self._delete_everywhere(pod.key)
-            attempts = e.attempts if e else 0
-            self._push_active(_Entry(pod=pod, attempts=attempts, timestamp=now))
+            old = self._delete_everywhere(pod.key)
+            attempts = old.attempts if old else 0
+            e = _Entry(pod=pod, attempts=attempts, timestamp=now)
+            self._push_active(e)
+            # an update is a fresh watch delta; first-attempt pods stay
+            # micro-eligible (a retried pod keeps bulk-lane routing)
+            if attempts == 0 and self._micro_eligible(pod):
+                self._micro[pod.key] = e
 
     def delete(self, key: str) -> None:
         with self._mu:
@@ -197,9 +224,46 @@ class PriorityQueue:
                 if self._active_keys.get(e.pod.key) is not e:
                     continue  # stale heap entry
                 del self._active_keys[e.pod.key]
+                self._micro.pop(e.pod.key, None)
                 e.attempts += 1
                 out.append((e.pod, e.attempts))
         return out
+
+    def pop_micro(self, max_n: int, now: float = 0.0) -> List[Tuple[Pod, int]]:
+        """Drain up to max_n micro-eligible pods (ISSUE 18): same contract
+        as pop_batch — comparator order, attempts incremented, the
+        scheduling-cycle counter bumped so mid-flight move requests route
+        failures to backoffQ exactly as for a bulk wave — but selecting
+        only from the micro view. The selected pods leave activeQ too (one
+        pod is in flight through exactly one wave)."""
+        out: List[Tuple[Pod, int]] = []
+        with self._mu:
+            self._cycle += 1
+            # INVARIANT: every _micro entry IS its _active_keys entry —
+            # all removal paths (_delete_everywhere, pop_batch, pop_micro)
+            # evict the view eagerly, so no identity re-validation here
+            live = sorted(self._micro.values(), key=_active_key)
+            for e in live[:max_n]:
+                del self._active_keys[e.pod.key]
+                del self._micro[e.pod.key]
+                e.attempts += 1
+                out.append((e.pod, e.attempts))
+            # stale heap tuples for the popped keys are lazily discarded
+            # by pop_batch's identity check, as for every other promotion
+        return out
+
+    def micro_stats(self) -> Tuple[int, int, float]:
+        """(micro-eligible depth, activeQ depth, oldest micro admission
+        timestamp) — the scheduler's micro/bulk arbitration signal, O(1)
+        (it runs on every schedule_pending call). The oldest stamp bounds
+        the coalesce window (0.0 when the lane is empty); depths
+        diverging means activeQ holds micro-INeligible pods and the next
+        wave must be a bulk wave. Insertion order of the view tracks
+        admission time, so the first entry is the oldest."""
+        with self._mu:
+            oldest = (next(iter(self._micro.values())).timestamp
+                      if self._micro else 0.0)
+            return (len(self._micro), len(self._active_keys), oldest)
 
     def add_prompt_retry(self, pod: Pod, attempts: int,
                          now: float = 0.0) -> None:

@@ -88,6 +88,10 @@ class CycleStats:
     # device time)
     shed: int = 0
     commit_paused: int = 0
+    # streaming micro-wave admission (ISSUE 18): 1 when this wave was a
+    # micro-wave — a small fresh-delta batch grafted onto the resident
+    # snapshot between bulk cycles (sub-cycle watch→bind latency)
+    micro: int = 0
     # pods deferred by the DRF quota pre-mask this tick (fleet/server.py;
     # a subset of `requeued`) — routed through sched/metrics.py
     # observe_fleet_tick so the fleet bench asserts the clamp from the
@@ -118,6 +122,7 @@ class Scheduler:
         mesh: object = None,
         ledger: Optional["object"] = None,
         fence_source: Optional[Callable[[], int]] = None,
+        microwave: Optional[bool] = None,
     ) -> None:
         self.binder = binder
         # exactly-once binding across crash/restart (sched/ledger.py): when
@@ -233,6 +238,38 @@ class Scheduler:
 
         self.explainer = build_explainer(name=scheduler_name,
                                          clock=self.clock)
+        # streaming micro-waves (ISSUE 18): when the live backlog is
+        # nothing but a handful of FRESH watch deltas, admit them through
+        # a small fixed-capacity wave grafted onto the resident snapshot
+        # (state/cache.py micro_graft) instead of parking them until a
+        # bulk cycle pops. Opt-in: KTPU_MICROWAVE=1 (or the ctor flag);
+        # off/unset keeps the wave pipeline byte-for-byte the bulk-only
+        # code path — the micro branches below are simply never taken.
+        import os as _os
+
+        if microwave is None:
+            microwave = _os.environ.get(
+                "KTPU_MICROWAVE", "") not in ("", "0", "off")
+        self.microwave = bool(microwave)
+        # lane capacity: a fresh backlog deeper than this is bulk work
+        # (one big wave beats many small ones); clamped to the configured
+        # batch so tests with tiny batches keep their wave-size contract
+        self.micro_max_batch = min(
+            int(_os.environ.get("KTPU_MICRO_MAX_BATCH", "128")),
+            max(int(batch_size), 1))
+        # coalesce window: hold a not-yet-full lane this long so
+        # near-simultaneous deltas share one dispatch. 0 (default) admits
+        # immediately — latency-optimal; docs/PERF.md has the math for
+        # when a window pays.
+        self.micro_coalesce_s = float(
+            _os.environ.get("KTPU_MICRO_COALESCE_S", "0"))
+        # every micro wave encodes at ONE fixed pending capacity, so all
+        # micro dispatches share a single compile signature per cluster
+        # shape regardless of delta burstiness
+        from ..state.dims import bucket as _bucket
+
+        self._micro_p = _bucket(self.micro_max_batch)
+        self.micro_waves = 0
 
     def enable_explain(self, sink=None) -> None:
         """Force decision provenance on for this scheduler (the
@@ -347,7 +384,48 @@ class Scheduler:
                                   device=self.supervisor.snapshot_device(),
                                   mesh=self.supervisor.snapshot_mesh())
 
-    def schedule_pending(self, now: Optional[float] = None) -> CycleStats:
+    def _micro_snapshot_keys(self, pending: List[Pod]):
+        # micro path (ISSUE 18): sync the resident tables with an EMPTY
+        # pending patch (full reuse of the double-buffer/donation
+        # machinery), then graft a small fixed-P pending block for just
+        # these deltas — the bulk-P pending buffer is never rebuilt for a
+        # handful of pods
+        from .cycle import micro_snapshot_with_keys
+
+        return micro_snapshot_with_keys(
+            self.cache, self.encoder, pending, self.base_dims,
+            self._micro_p,
+            device=self.supervisor.snapshot_device(),
+            mesh=self.supervisor.snapshot_mesh())
+
+    def _micro_mode(self, now: float) -> str:
+        """Micro/bulk arbitration, decided once per wave after the
+        governor gate: "micro" only when the ENTIRE live backlog is the
+        micro lane (fresh, ungrouped, unpinned deltas) and fits one micro
+        wave — anything mixed or deep is bulk work, where one full wave
+        admits everything the lane holds anyway. "hold" keeps a
+        not-yet-full lane waiting out the coalesce window (never when the
+        window is off or the lane is full)."""
+        if not self.microwave or self.extenders:
+            return "bulk"
+        micro_depth, active_depth, oldest = self.queue.micro_stats()
+        if micro_depth == 0 or micro_depth != active_depth \
+                or micro_depth > self.micro_max_batch:
+            return "bulk"
+        if self.micro_coalesce_s > 0.0 \
+                and micro_depth < self.micro_max_batch \
+                and (now - oldest) < self.micro_coalesce_s:
+            return "hold"
+        return "micro"
+
+    def schedule_micro(self, now: Optional[float] = None) -> CycleStats:
+        """At most one micro-wave: admit the fresh-delta lane if (and only
+        if) arbitration says "micro"; empty stats otherwise. The fleet
+        tick interleaves this per tenant between bulk cadences."""
+        return self.schedule_pending(now, micro_only=True)
+
+    def schedule_pending(self, now: Optional[float] = None,
+                         micro_only: bool = False) -> CycleStats:
         """One wave: pump → pop batch → snapshot → device cycle → commit.
 
         Sequential assume semantics hold *within* the wave (the device scan
@@ -361,7 +439,8 @@ class Scheduler:
         span = self.telemetry.wave_span()
         ctx: Dict[str, object] = {}
         try:
-            return self._run_wave(span, now, t0, ctx)
+            return self._run_wave(span, now, t0, ctx,
+                                  micro_only=micro_only)
         except Exception:
             # a wave that DIES mid-flight is exactly the tick the flight
             # recorder exists to explain: record what ran before the raise
@@ -392,7 +471,8 @@ class Scheduler:
             self.telemetry.finish_wave(span, stats=stats, engine=engine)
 
     def _run_wave(self, span, now: float, t0: float,
-                  ctx: Dict[str, object]) -> CycleStats:
+                  ctx: Dict[str, object],
+                  micro_only: bool = False) -> CycleStats:
         self.queue.pump(now)
         self.cache.cleanup(now)
         self.expire_waiting(now)
@@ -422,7 +502,32 @@ class Scheduler:
                 return stats
             if decision.wave_limit:
                 pop_limit = min(pop_limit, decision.wave_limit)
-        batch = self.queue.pop_batch(pop_limit, now=now)
+        # ---- micro/bulk arbitration (ISSUE 18): AFTER the governor gate,
+        # so a breaker pause dominates (a micro wave is still a wave) and
+        # a deferred release lands in the depths the decision reads ---- #
+        mode = self._micro_mode(now)
+        if micro_only and mode != "micro":
+            # fleet interleave probe (schedule_micro): the lane isn't
+            # micro-ready — leave the backlog to the bulk cadence
+            stats = CycleStats()
+            ctx["stats"] = stats
+            stats.cycle_seconds = time.perf_counter() - t0
+            self._drain_idle_events(span, stats)
+            return stats
+        if mode == "hold":
+            # coalesce window open: near-simultaneous deltas share the
+            # next micro dispatch instead of paying one wave each
+            stats = CycleStats()
+            ctx["stats"] = stats
+            stats.cycle_seconds = time.perf_counter() - t0
+            self._drain_idle_events(span, stats, engine="hold")
+            return stats
+        micro = mode == "micro"
+        if micro:
+            batch = self.queue.pop_micro(
+                min(pop_limit, self.micro_max_batch), now=now)
+        else:
+            batch = self.queue.pop_batch(pop_limit, now=now)
         cycle = self.queue.current_cycle()
         span.mark("pop")
         # ---- priority-aware shedding (SHED_LOW/TRICKLE): park sheddable
@@ -441,7 +546,8 @@ class Scheduler:
             batch = kept
             if shed_n:
                 gov.note_shed(shed_n)
-        stats = CycleStats(attempted=len(batch), shed=shed_n)
+        stats = CycleStats(attempted=len(batch), shed=shed_n,
+                           micro=1 if micro else 0)
         ctx["stats"] = stats
 
         # pods an extender is interested in take the per-pod extender path
@@ -470,7 +576,8 @@ class Scheduler:
             return stats
 
         pending = [p for p, _ in batch]
-        snap, keys = self._snapshot_keys(pending)
+        snap, keys = (self._micro_snapshot_keys(pending) if micro
+                      else self._snapshot_keys(pending))
         span.mark("snapshot")
         extras = tuple(p for p, _ in self._extra_score)
         extra_w = tuple(w for _, w in self._extra_score)
@@ -500,6 +607,23 @@ class Scheduler:
             mesh=snap.mesh, rc=rc)
         self.supervisor.note_cycle_signature(
             snap.dims, wave_engine, extras, gang_arg is not None, rc=rc)
+        if self.microwave and not micro and snap.runs is None:
+            # keep the micro signature warm from the bulk cadence: the
+            # first delta after a quiet period must not pay a compile on
+            # the latency path. (The runs engine's rc varies per micro
+            # batch, so its micro programs compile on first use — small-P
+            # traces are cheap.)
+            self.prewarmer.ensure_warm(
+                _dc_replace(snap.dims, P=self._micro_p,
+                            has_node_name=False),
+                eng, extras, False, mesh=snap.mesh, rc=0)
+        if self.microwave:
+            # the patch-scatter ladder is the OTHER compile micro-waves
+            # cannot amortize: a fresh dirty-row bucket mid-churn stalls a
+            # milliseconds-sized wave ~0.5 s (state/cache.py
+            # warm_patch_ladder)
+            self.prewarmer.ensure_patch_ladder(self.cache, snap,
+                                               mesh=snap.mesh)
         span.mark("prewarm")
 
         explain_on = self.explainer is not None
@@ -639,7 +763,11 @@ class Scheduler:
                 # first storm
                 self.prewarmer.observe_preempt(snap.dims, PREEMPT_BURST,
                                                mesh=snap.mesh)
-            backlog = self.queue.peek_active(self.batch_size)
+            # a micro wave skips the prestage overlap: its dispatch is
+            # sub-cycle, and interning a bulk backlog under it would put
+            # the bulk cost back on the latency path it exists to dodge
+            backlog = [] if micro \
+                else self.queue.peek_active(self.batch_size)
             if backlog:
                 self.encoder.intern_pods(backlog)
                 if snap.mesh is not None:
@@ -685,7 +813,8 @@ class Scheduler:
                 # the dead tick is reconstructable from the artifact
                 self.telemetry.finish_wave(span, stats=stats,
                                            engine=wave_engine,
-                                           dims=snap.dims, rc=rc)
+                                           dims=snap.dims, rc=rc,
+                                           micro=micro)
                 return stats
         finally:
             # the dispatch no longer holds the snapshot's arrays — the
@@ -734,6 +863,7 @@ class Scheduler:
             commits = []
             intent = None
         span.mark("intent-write")
+        bound_keys: List[str] = []
         for ci, (pod, node_name, attempts) in enumerate(commits):
             if self.governor is not None \
                     and not self.governor.commit_allowed():
@@ -748,7 +878,16 @@ class Scheduler:
                     self.queue.add_prompt_retry(pod2, attempts=attempts2,
                                                 now=now)
                 break
-            self._commit(pod, node_name, attempts, now, cycle, stats)
+            self._commit(pod, node_name, attempts, now, cycle, stats,
+                         latency_keys=bound_keys)
+        # e2e watch→bind spans close in ONE batched call per wave (the
+        # per-pod scalar path was most of the measured telemetry
+        # overhead); the clock reading is the end of the commit loop —
+        # within one wave the per-commit readings it replaces differ by
+        # commit-tail microseconds, and deterministic per-tick clocks are
+        # constant across a wave, so virtual latencies are unchanged
+        if bound_keys:
+            self.telemetry.record_bound_many(bound_keys, self.clock())
         span.mark("bind-commit")
         self._retire_intent(intent)
         span.mark("retire")
@@ -788,10 +927,19 @@ class Scheduler:
         span.mark("requeue")
         stats.cycle_seconds = time.perf_counter() - t0
         if self.governor is not None:
+            # micro=True keeps the ingest estimate fed but fences micro
+            # timings out of the slow-streak/wave-sizing control loop —
+            # sub-cycle micro waves say nothing about bulk deadlines
             self.governor.end_wave(now, stats.attempted,
-                                   stats.cycle_seconds)
+                                   stats.cycle_seconds, micro=micro)
+        if micro:
+            self.micro_waves += 1
+            from .metrics import MICRO_WAVES
+
+            MICRO_WAVES.inc(scheduler=self.scheduler_name)
         self.telemetry.finish_wave(
             span, stats=stats, engine=wave_engine, dims=snap.dims, rc=rc,
+            micro=micro,
             extra={"explain": explain_rec} if explain_rec else None)
         return stats
 
@@ -1091,6 +1239,7 @@ class Scheduler:
         cycle: int,
         stats: CycleStats,
         binder_ext: Optional["object"] = None,
+        latency_keys: Optional[List[str]] = None,
     ) -> None:
         fw = self.framework
         state = None
@@ -1150,8 +1299,13 @@ class Scheduler:
             # binding wave's own snapshot/dispatch/commit time is part of
             # the span being claimed (under a per-tick deterministic
             # clock the two readings coincide, so virtual latencies are
-            # unchanged)
-            self.telemetry.record_bound(pod.key, self.clock())
+            # unchanged). Wave callers pass `latency_keys` to close the
+            # whole wave's spans in one batched call instead (the per-pod
+            # scalar path was most of the measured telemetry overhead).
+            if latency_keys is not None:
+                latency_keys.append(pod.key)
+            else:
+                self.telemetry.record_bound(pod.key, self.clock())
             stats.scheduled += 1
             stats.assignments[pod.key] = node_name
             if fw is not None and state is not None:

@@ -107,6 +107,27 @@ def _pad_patch(idx: List[int], k_bucket: int) -> np.ndarray:
     return out
 
 
+def _patch_bucket(n: int) -> int:
+    """Patch-scatter index bucket: pure powers of two, FLOORED at 64.
+
+    Deliberately NOT dims.bucket(): that ladder runs eight rungs per
+    octave — right for capacity dims, where padding waste multiplies
+    through every engine plane, but each rung here is a distinct
+    `_patch_rows` compile signature, and a first-seen rung is a ~0.5 s
+    synchronous XLA compile in the middle of a wave. Streaming
+    micro-waves (ISSUE 18), whose entire point is that a 3-pod admission
+    finishes in milliseconds, cannot absorb that — under churn the varying
+    dirty-row counts walked a new rung every few waves, each one a
+    p99-destroying stall. A patch scatter's padding is idempotent
+    repeated-index rows (microseconds of device work), so the coarse
+    pow2-with-floor ladder costs nothing measurable and keeps the whole
+    signature set small enough for warm_patch_ladder to precompile."""
+    p = 64
+    while p < n:
+        p <<= 1
+    return p
+
+
 @dataclass
 class _PodState:
     """podState (cache.go:52-58): the pod plus its assume bookkeeping."""
@@ -219,6 +240,9 @@ class SchedulerCache:
         # nets snapshot `needed` against these — minMember already satisfied
         # by running members doesn't have to re-place)
         self._group_bound: Dict[str, int] = {}
+        # patch-scatter signatures already AOT-compiled by warm_patch_ladder
+        # ((plane shapes, kb, donate) tuples — see the method)
+        self._ladder_warmed: Set[Tuple] = set()
 
     # -- dirty-tracking helpers (callers hold self._mu) -- #
 
@@ -502,11 +526,20 @@ class SchedulerCache:
                     and snap.pending_keys == pending_keys \
                     and snap.device == device and snap.mesh is mesh \
                     and (base_dims is None
-                         or snap.dims == snap.dims.union(base_dims)):
+                         or snap.dims == snap.dims.union(base_dims)) \
+                    and self._reg_sizes == self._registry_sizes(encoder):
                 # the base_dims guard: a caller may GROW the floor between
                 # calls (the fleet bucket following another tenant's
                 # growth) — a cached snapshot at the old capacities must
-                # not short-circuit the re-encode that pads this tenant up
+                # not short-circuit the re-encode that pads this tenant up.
+                # The registry-sizes guard: the micro path (ISSUE 18)
+                # interns its watch-delta pods BEFORE asking for the base
+                # snapshot with an EMPTY pending batch — generation and
+                # pending signature both unchanged — so a first-seen
+                # request/labelset/class must fall through to the patch
+                # path's grown-table rebuild, or the graft would score the
+                # new pods against interned tables that end before their
+                # ids (a wrong unschedulable verdict, not a crash).
                 self.last_snapshot_mode = "cached"
                 return snap
 
@@ -653,6 +686,126 @@ class SchedulerCache:
             return self._patch_snapshot(encoder, pending, pending_keys,
                                         gen, d, snap, released_nodes,
                                         device, mesh)
+
+    def micro_graft(self, encoder: Encoder, pending: Sequence[Pod],
+                    base: Snapshot, micro_p: int,
+                    device: object = None, mesh: object = None) -> Snapshot:
+        """Micro-wave pending graft (ISSUE 18 streaming admission): an
+        EPHEMERAL Snapshot sharing `base`'s resident cluster tables and
+        existing-pod arrays (the double-buffered device state stays
+        untouched — the caller just brought it current via the ordinary
+        generation-diffed `snapshot()` with an empty pending batch) with a
+        small standalone [micro_p] pending block for the watch-delta pods.
+
+        The graft is NOT stored as `_snapshot`: the cached resident view
+        keeps diffing against the bulk pipeline's snapshots, so a micro
+        wave between two bulk waves costs the bulk path nothing. Dims are
+        `base.dims` with only P swapped to the fixed micro capacity (and
+        has_node_name False — queue eligibility excludes pinned pods), so
+        every micro wave of a given cluster shape shares ONE compile
+        signature regardless of how many deltas coalesced. The caller
+        must have interned `pending` into `encoder` BEFORE building
+        `base` (cycle.micro_snapshot_with_keys does), so any registry or
+        capacity growth the new pods cause is already reflected in
+        `base.dims`/`base.tables`."""
+        d = replace(base.dims, P=micro_p, has_node_name=False)
+        with self._mu:
+            pe_host = encoder.build_pod_arrays(
+                list(pending), d, self._node_slot, capacity=d.P)
+            runs_plan = None
+            if self._runs_wanted():
+                runs_plan = self._run_plan_from_cols(
+                    pe_host.cls, pe_host.priority, pe_host.creation,
+                    pe_host.valid, pe_host.node_name_req)
+            gang = self._gang_arrays(encoder, pending, d, mesh)
+        return Snapshot(
+            generation=base.generation,
+            node_order=base.node_order,
+            tables=base.tables,
+            existing=base.existing,
+            pending=self._put(pe_host, device, mesh),
+            dims=d,
+            pending_keys=tuple((p.key, id(p)) for p in pending),
+            existing_keys=base.existing_keys,
+            gang=gang,
+            device=device,
+            mesh=mesh,
+            runs=runs_plan,
+        )
+
+    def warm_patch_ladder(self, snap: Snapshot, mesh=None) -> int:
+        """Pre-populate the patch-scatter compile ladder for `snap`'s
+        resident planes (nodes / existing / pending) by driving real
+        no-op scatters through the live jit dispatch path.
+
+        Each `_patch_rows` specialization is keyed by (plane shapes, index
+        bucket); with `_patch_bucket`'s floor the ladder per plane is
+        {64, 128, ..., capacity}, and without this warm each rung costs a
+        synchronous ~0.5 s XLA compile the first wave that dirties that
+        many rows — exactly the stall profile streaming micro-waves
+        (ISSUE 18) cannot absorb, since their entire point is that a
+        3-pod wave finishes in milliseconds. The warm must be a REAL call,
+        not `.lower().compile()`: an AOT-compiled object is a separate
+        executable and does not seed the tracing cache the live dispatch
+        consults, so an abstract warm leaves the first live wave paying
+        the full compile anyway (measured: 0.44 s after a same-process
+        abstract warm). A real scatter of row 0's own value at index 0 is
+        idempotent on the output and the non-donated input is never
+        mutated, so warming against the live resident tree is safe; the
+        donated variant warms against a host-roundtrip copy so the
+        resident buffers are not consumed. Returns the number of
+        signatures compiled by THIS call; repeat calls are cheap
+        (memoized on plane shapes). Safe to run from a background thread —
+        jit dispatch is thread-safe and the warm never mutates the cache."""
+        import jax
+
+        compiled = 0
+        for tree in (snap.tables.nodes, snap.existing, snap.pending):
+            leaves = jax.tree.leaves(tree)
+            if not leaves:
+                continue
+            # top rung: _patch_bucket(cap), not cap — capacities are
+            # eight-per-octave (dims.bucket) or mesh-padded, i.e. usually
+            # non-pow2, and the live ladder rounds up past them
+            top = _patch_bucket(int(leaves[0].shape[0]))
+            shapes = tuple((tuple(a.shape), str(a.dtype)) for a in leaves)
+            kb = 64
+            while True:
+                for donate in ((False, True) if mesh is not None
+                               else (False,)):
+                    key = (shapes, kb, donate)
+                    if key in self._ladder_warmed:
+                        continue
+                    self._ladder_warmed.add(key)
+                    idx = np.zeros((kb,), I32)
+                    # rows match the live call exactly: host numpy, same
+                    # trailing shape per leaf. Zero payload is fine — the
+                    # output is discarded.
+                    rows = jax.tree.map(
+                        lambda a, _kb=kb: np.zeros(
+                            (_kb,) + tuple(a.shape[1:]), a.dtype), tree)
+                    try:
+                        if donate:
+                            # donation consumes its input: warm against a
+                            # throwaway copy (host roundtrip preserves the
+                            # sharding without aliasing the resident tree)
+                            scratch = jax.tree.map(
+                                lambda a: jax.device_put(
+                                    np.asarray(a),
+                                    getattr(a, "sharding", None)), tree)
+                            out = _patch_rows_donated(scratch, idx, rows)
+                        else:
+                            out = _patch_rows(tree, idx, rows)
+                        jax.block_until_ready(out)
+                        compiled += 1
+                    except Exception:  # noqa: BLE001 - warm is an
+                        # optimization, never fatal; the live path compiles
+                        # on demand exactly as without the ladder
+                        self._ladder_warmed.discard(key)
+                if kb >= top:
+                    break
+                kb *= 2
+        return compiled
 
     @staticmethod
     def _registry_sizes(encoder: Encoder) -> Dict[str, int]:
@@ -923,7 +1076,7 @@ class SchedulerCache:
                     domain=put_topo(self._staging_nodes.domain)),
                 zone_keys=self._put(encoder.build_zone_keys(), device, mesh))
         if node_idx:
-            kb = bucket(len(node_idx))
+            kb = _patch_bucket(len(node_idx))
             idx = _pad_patch(node_idx, kb)
             rows = NodeArrays(*[np.ascontiguousarray(f[idx])
                                 for f in self._staging_nodes])
@@ -994,7 +1147,7 @@ class SchedulerCache:
 
         existing = snap.existing
         if pod_idx:
-            kb = bucket(len(pod_idx))
+            kb = _patch_bucket(len(pod_idx))
             idx = _pad_patch(pod_idx, kb)
             host = self._existing_pod_arrays(d)
             rows = PodArrays(*[np.ascontiguousarray(f[idx]) for f in host])
@@ -1086,7 +1239,7 @@ class SchedulerCache:
                         p.node_name, -1) if p.node_name else -1
                     stage.valid[i] = True
                 self._pending_stage_keys = pending_keys
-                kb = bucket(len(changed))
+                kb = _patch_bucket(len(changed))
                 idx = _pad_patch(changed, kb)
                 rows = PodArrays(
                     valid=stage.valid[idx],

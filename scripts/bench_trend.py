@@ -85,6 +85,17 @@ def _regressed(op: str, old: float, new: float, tol: float) -> bool:
     return new < old * (1.0 - tol) and new < old - 1e-9
 
 
+def _time_like(metric: str) -> bool:
+    """Metrics whose VALUE is a function of the hardware the run measured
+    on (throughput, wall-clock, overhead ratios of wall-clocks) — a
+    cpu-run vs tpu-run diff of these is a hardware comparison, not a code
+    regression. Proof counters and invariants (lost_pods, dispatches,
+    *_bit_equal, e2e_recorded, ...) are NOT time-like: those must hold on
+    every backend, so they gate across backends too."""
+    return metric == "pods_per_sec" or metric.endswith(
+        ("_ms", "_seconds", "_s", "_pct", "_per_sec", "_speedup"))
+
+
 def compare(old_stages, new_stages, tol: float):
     """(delta lines, regression strings)."""
     lines, regressions = [], []
@@ -96,6 +107,13 @@ def compare(old_stages, new_stages, tol: float):
         if old is None:
             lines.append(f"{tag}: NEW stage (no prior run)")
             continue
+        # backend-aware gating: when the two runs measured on different
+        # backends, time-like deltas are annotated and NOT gated
+        ob, nb = old.get("backend"), new.get("backend")
+        cross = bool(ob and nb and ob != nb)
+        if cross:
+            lines.append(f"{tag}: [cross-backend {ob}->{nb}] time-like "
+                         f"metrics informational; invariants still gate")
         checked = {"pods_per_sec": ">=", "cycle_seconds": "<="}
         checked.update(_budget_metrics(kind, nodes))
         for metric, op in sorted(checked.items()):
@@ -108,10 +126,13 @@ def compare(old_stages, new_stages, tol: float):
             # cycle_seconds drift is informational (the absolute budget in
             # bench.py is the enforced bound); budget metrics gate
             if metric != "cycle_seconds" and _regressed(op, ov, nv, tol):
-                mark = "  <-- REGRESSION"
-                regressions.append(
-                    f"{tag} {metric}: {ov} -> {nv} ({pct:+.1f}%, op {op}, "
-                    f"tolerance {tol:.0%})")
+                if cross and _time_like(metric):
+                    mark = f"  [cross-backend {ob}->{nb}, not gated]"
+                else:
+                    mark = "  <-- REGRESSION"
+                    regressions.append(
+                        f"{tag} {metric}: {ov} -> {nv} ({pct:+.1f}%, "
+                        f"op {op}, tolerance {tol:.0%})")
             lines.append(f"{tag}: {metric} {ov} -> {nv} ({pct:+.1f}%){mark}")
     for key in sorted(set(old_stages) - set(new_stages), key=str):
         kind, nodes, pods = key

@@ -1,0 +1,368 @@
+"""Streaming micro-wave admission (ISSUE 18, `microwave` marker): the
+micro/bulk arbitration contract, the KTPU_MICROWAVE kill switch's
+bit-equality, guardrail composition (commit breaker dominates, ledger
+intents bracket micro commits — crash mid-micro reconciles exactly
+once), the fleet micro_pass's per-tenant isolation, and the
+patch-scatter compile-ladder warm that keeps micro waves stall-free.
+Deterministic clocks throughout; dims stay tiny so compiles are cheap.
+"""
+
+import dataclasses
+
+import pytest
+
+from kubernetes_tpu.api.types import Node, Pod, Resources
+from kubernetes_tpu.sched.ledger import BindIntentLedger
+from kubernetes_tpu.sched.metrics import MICRO_WAVES
+from kubernetes_tpu.sched.overload import (
+    OPEN,
+    OverloadConfig,
+    OverloadGovernor,
+)
+from kubernetes_tpu.sched.scheduler import RecordingBinder, Scheduler
+from kubernetes_tpu.state.cache import _patch_bucket
+from kubernetes_tpu.state.dims import Dims
+from kubernetes_tpu.storage.native import PyKV
+from kubernetes_tpu.storage.store import Storage
+from kubernetes_tpu.utils import faultline
+
+pytestmark = pytest.mark.microwave
+
+HOSTNAME = "kubernetes.io/hostname"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faultline():
+    yield
+    faultline.uninstall()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def mknode(name, cpu=4, mem="8Gi", **kw):
+    kw.setdefault("labels", {HOSTNAME: name})
+    return Node(name=name,
+                allocatable=Resources.make(cpu=cpu, memory=mem, pods=110),
+                **kw)
+
+
+def mkpod(name, cpu="100m", mem="64Mi", **kw):
+    return Pod(name=name, requests=Resources.make(cpu=cpu, memory=mem),
+               **kw)
+
+
+def _sched(clock=None, batch=8, n_nodes=4, microwave=True, **kw):
+    s = Scheduler(binder=kw.pop("binder", None) or RecordingBinder(),
+                  batch_size=batch, clock=clock or FakeClock(),
+                  microwave=microwave, **kw)
+    s.prewarmer.enabled = False
+    for i in range(n_nodes):
+        s.on_node_add(mknode(f"n{i}"))
+    return s
+
+
+# --------------------------------------------------------------------- #
+# arbitration: what is (and is not) a micro wave
+# --------------------------------------------------------------------- #
+
+
+class TestArbitration:
+    def test_fresh_deltas_admit_as_micro_wave(self):
+        s = _sched()
+        before = MICRO_WAVES.value(scheduler=s.scheduler_name)
+        for i in range(3):
+            s.on_pod_add(mkpod(f"p{i}", creation_index=i))
+        st = s.schedule_pending()
+        assert st.micro == 1
+        assert st.scheduled == 3
+        assert s.micro_waves == 1
+        assert len(s.binder.bound) == 3
+        assert MICRO_WAVES.value(scheduler=s.scheduler_name) == before + 1
+
+    def test_default_is_off_and_bulk_only(self, monkeypatch):
+        monkeypatch.delenv("KTPU_MICROWAVE", raising=False)
+        s = _sched(microwave=None)
+        assert s.microwave is False
+        s.on_pod_add(mkpod("p0"))
+        st = s.schedule_pending()
+        assert st.micro == 0 and st.scheduled == 1
+        assert s.micro_waves == 0
+
+    @pytest.mark.parametrize("val,on", [
+        ("1", True), ("yes", True), ("0", False), ("off", False),
+        ("", False),
+    ])
+    def test_env_opt_in(self, monkeypatch, val, on):
+        monkeypatch.setenv("KTPU_MICROWAVE", val)
+        s = Scheduler(binder=RecordingBinder())
+        assert s.microwave is on
+
+    def test_mixed_lane_forces_bulk(self):
+        """A retry riding activeQ alongside fresh deltas means depths
+        diverge from the micro view — the whole backlog is bulk work."""
+        clk = FakeClock()
+        s = _sched(clk)
+        s.on_pod_add(mkpod("fresh", creation_index=0))
+        s.queue.add_prompt_retry(mkpod("retry", creation_index=1),
+                                 attempts=2, now=clk.t)
+        st = s.schedule_pending(now=clk.advance(0.1))
+        assert st.micro == 0
+        assert st.scheduled == 2        # bulk admits everything anyway
+        assert s.micro_waves == 0
+
+    def test_deep_lane_forces_bulk(self):
+        """A fresh backlog deeper than micro_max_batch is bulk work: one
+        big wave beats many small ones."""
+        s = _sched(batch=8)             # micro_max_batch clamps to 8
+        assert s.micro_max_batch == 8
+        for i in range(9):
+            s.on_pod_add(mkpod(f"p{i}", creation_index=i))
+        st = s.schedule_pending()
+        assert st.micro == 0 and st.scheduled == 8   # one bulk pop
+        # the single leftover delta is a legitimate micro lane once the
+        # deep backlog drained — only the DEEP wave had to be bulk
+        s.run_until_idle()
+        assert len(s.binder.bound) == 9
+
+    def test_schedule_micro_noop_when_lane_not_micro_ready(self):
+        """The fleet interleave probe: schedule_micro on a non-micro
+        backlog admits NOTHING and leaves the backlog for bulk cadence."""
+        s = _sched(batch=4)
+        for i in range(5):              # deeper than micro_max_batch
+            s.on_pod_add(mkpod(f"p{i}", creation_index=i))
+        st = s.schedule_micro()
+        assert st.micro == 0 and st.attempted == 0
+        assert s.queue.lengths()[0] == 5          # untouched
+        assert s.binder.bound == []
+
+    def test_coalesce_window_holds_then_admits(self, monkeypatch):
+        """KTPU_MICRO_COALESCE_S holds a not-yet-full lane so near-
+        simultaneous deltas share one dispatch; the window closing (or a
+        full lane) admits."""
+        monkeypatch.setenv("KTPU_MICRO_COALESCE_S", "0.5")
+        clk = FakeClock()
+        s = _sched(clk)
+        s.on_pod_add(mkpod("p0", creation_index=0))
+        st = s.schedule_pending(now=clk.advance(0.1))
+        assert st.micro == 0 and st.attempted == 0    # held
+        assert s.queue.lengths()[0] == 1
+        st = s.schedule_pending(now=clk.advance(0.6))  # window expired
+        assert st.micro == 1 and st.scheduled == 1
+
+
+# --------------------------------------------------------------------- #
+# guardrails: the micro path composes with every safety system
+# --------------------------------------------------------------------- #
+
+
+class TestGuardrails:
+    def test_kill_switch_bit_equality(self):
+        """KTPU_MICROWAVE off reproduces the bulk pipeline's placements
+        byte-for-byte for the same event sequence."""
+        results = {}
+        for micro in (False, True):
+            s = _sched(FakeClock(), microwave=micro)
+            assignments = {}
+            for i in range(6):
+                s.on_pod_add(mkpod(f"p{i}", creation_index=i))
+                st = s.schedule_pending()
+                assignments.update(st.assignments)
+            results[micro] = (assignments, s.micro_waves)
+        assert results[False][0] == results[True][0]
+        assert results[False][1] == 0
+        assert results[True][1] >= 1
+
+    def test_breaker_pause_dominates_micro(self):
+        """The commit breaker gates micro waves exactly like bulk: an
+        OPEN breaker pauses dispatch BEFORE arbitration — no pop, no
+        device time, nothing lost."""
+        clk = FakeClock()
+        s = _sched(clk)
+        cfg = OverloadConfig(fail_threshold=3, cooldown_s=1.0)
+        s.governor = OverloadGovernor(
+            8, cfg=cfg, clock=clk,
+            event_sink=s.telemetry.note_supervisor_event)
+        for _ in range(3):
+            s.governor.note_commit(False, 0.01)
+        assert s.governor.breaker.state == OPEN
+        s.on_pod_add(mkpod("p0"))
+        st = s.schedule_pending(now=clk.advance(0.1))
+        assert st.commit_paused == 1
+        assert st.micro == 0 and st.attempted == 0
+        assert s.binder.bound == []
+        assert s.queue.lengths()[0] == 1          # nothing lost
+        # breaker half-open probe admits the delta — as a micro wave
+        st = s.schedule_pending(now=clk.advance(1.1))
+        assert st.scheduled == 1 and st.micro == 1
+
+    def test_unschedulable_flows_through_micro(self):
+        """A fresh delta that fits nowhere earns its failure verdict in
+        the micro wave — same unschedulable routing as bulk."""
+        s = _sched(n_nodes=1)
+        s.on_pod_add(mkpod("huge", cpu="64"))
+        st = s.schedule_pending()
+        assert st.micro == 1
+        assert st.unschedulable == 1
+        assert "default/huge" in st.failed_keys
+        assert s.queue.lengths()[2] == 1
+
+    def test_crash_mid_micro_commit_reconciles_exactly_once(self):
+        """Ledger intents bracket micro commits exactly like bulk: a
+        crash after the intent write (before the Binding) leaves a
+        durable intent; the restarted incarnation's replay completes it
+        without double-binding."""
+
+        class DurableBinder:
+            def __init__(self):
+                self.bound = {}
+                self.double_bind_attempts = 0
+
+            def bind(self, pod, node_name):
+                if pod.key in self.bound:
+                    self.double_bind_attempts += 1
+                    return False
+                self.bound[pod.key] = node_name
+                return True
+
+        storage = Storage(kv=PyKV())
+        binder = DurableBinder()
+        nodes = [mknode(f"n{i}") for i in range(2)]
+        pod = mkpod("m0")
+
+        def boot():
+            s = Scheduler(binder=binder,
+                          ledger=BindIntentLedger(storage),
+                          base_dims=Dims(N=16, P=16, E=64),
+                          batch_size=8, microwave=True)
+            s.prewarmer.enabled = False
+            for n in nodes:
+                s.on_node_add(n)
+            bound = binder.bound.get(pod.key, "")
+            s.on_pod_add(dataclasses.replace(pod, node_name=bound)
+                         if bound else pod)
+            return s
+
+        def lookup(key):
+            if key != pod.key:
+                return None
+            node = binder.bound.get(key, "")
+            return (dataclasses.replace(pod, node_name=node)
+                    if node else pod)
+
+        try:
+            s1 = boot()
+            faultline.install("proc.crash@post_intent:1")
+            with pytest.raises(faultline.InjectedCrash):
+                s1.schedule_pending()
+            faultline.uninstall()
+            assert binder.bound == {}                       # no Binding yet
+            assert len(BindIntentLedger(storage).unretired()) == 1
+
+            s2 = boot()
+            report = s2.recover(lookup=lookup)
+            assert report.replayed_intents == 1
+            s2.run_until_idle()
+            assert list(binder.bound) == [pod.key]
+            assert binder.double_bind_attempts == 0
+            assert s2.ledger.unretired() == []
+        finally:
+            storage.close()
+
+
+# --------------------------------------------------------------------- #
+# fleet: per-tenant micro interleave
+# --------------------------------------------------------------------- #
+
+
+class TestFleetMicroPass:
+    def _fleet(self, monkeypatch):
+        monkeypatch.setenv("KTPU_MICROWAVE", "1")
+        from kubernetes_tpu.fleet import FleetServer
+
+        clk = FakeClock()
+        srv = FleetServer(batch_size=16, clock=clk)
+        binders = {}
+        for name in ("ta", "tb"):
+            b = RecordingBinder()
+            binders[name] = b
+            t = srv.add_tenant(name, binder=b, quota=1.0)
+            for i in range(2):
+                t.on_node_add(mknode(f"n{i}"))
+        return srv, binders, clk
+
+    def test_micro_pass_admits_only_micro_ready_tenants(self, monkeypatch):
+        srv, binders, clk = self._fleet(monkeypatch)
+        ta = srv.tenants["ta"]
+        for i in range(2):
+            ta.on_pod_add(mkpod(f"a{i}", creation_index=i))
+        out = srv.micro_pass(clk.advance(0.1))
+        assert set(out) == {"ta"}
+        assert out["ta"].micro == 1 and out["ta"].scheduled == 2
+        assert len(binders["ta"].bound) == 2
+        assert binders["tb"].bound == []          # isolation: untouched
+
+    def test_tick_merges_micro_into_tenant_stats(self, monkeypatch):
+        srv, binders, clk = self._fleet(monkeypatch)
+        srv.tenants["tb"].on_pod_add(mkpod("b0", creation_index=0))
+        tick = srv.tick(clk.advance(0.1))
+        assert tick.per_tenant["tb"].micro == 1
+        assert tick.per_tenant["tb"].scheduled == 1
+        assert tick.per_tenant["ta"].micro == 0
+        assert len(binders["tb"].bound) == 1
+
+
+# --------------------------------------------------------------------- #
+# the patch-scatter compile ladder (the p99 stall fix)
+# --------------------------------------------------------------------- #
+
+
+class TestPatchLadder:
+    def test_patch_bucket_is_pow2_with_floor(self):
+        """The scatter-index ladder must stay pure pow2 (floored at 64):
+        dims.bucket's eight-rungs-per-octave would make every few waves'
+        dirty-row count a fresh ~0.5 s compile — the stall micro-waves
+        exist to avoid."""
+        assert _patch_bucket(1) == 64
+        assert _patch_bucket(64) == 64
+        assert _patch_bucket(65) == 128
+        assert _patch_bucket(128) == 128
+        assert _patch_bucket(1000) == 1024
+        # pow2 everywhere; monotone
+        prev = 0
+        for n in range(1, 3000, 37):
+            b = _patch_bucket(n)
+            assert b >= max(n, 64) and (b & (b - 1)) == 0
+            assert b >= prev or n < prev
+            prev = b
+
+    def test_warm_patch_ladder_compiles_once_and_memoizes(self):
+        s = _sched(n_nodes=2, base_dims=Dims(N=16, P=16, E=64))
+        snap = s.cache.snapshot(s.encoder, [], s.base_dims)
+        first = s.cache.warm_patch_ladder(snap)
+        assert first > 0
+        assert s.cache.warm_patch_ladder(snap) == 0   # memoized
+        # the warm never mutates resident state: snapshot stays cached
+        assert s.cache.snapshot(s.encoder, [], s.base_dims) is snap
+
+    def test_warmed_ladder_covers_live_patches(self):
+        """After the warm, a wave that dirties rows patches through an
+        already-compiled scatter and the resident planes still converge
+        to informer truth (correctness of the no-op warm calls)."""
+        s = _sched(n_nodes=2, base_dims=Dims(N=16, P=16, E=64))
+        s.cache.warm_patch_ladder(s.cache.snapshot(s.encoder, [],
+                                                   s.base_dims))
+        for i in range(3):
+            s.on_pod_add(mkpod(f"p{i}", creation_index=i))
+        st = s.schedule_pending()
+        assert st.micro == 1 and st.scheduled == 3
+        assert s.cache.last_snapshot_mode in ("patch", "full", "cached")
