@@ -118,7 +118,9 @@ class APIServer:
                  scheme: Optional[Scheme] = None,
                  max_inflight: Optional[int] = None,
                  max_mutating_inflight: Optional[int] = None,
-                 watch_buffer: Optional[int] = None):
+                 watch_buffer: Optional[int] = None,
+                 data_dir: Optional[str] = None,
+                 durability: Optional[str] = None):
         from kubernetes_tpu.apiserver.admission import AdmissionChain
         from kubernetes_tpu.apiserver.crd import install_crd_hook
 
@@ -137,7 +139,15 @@ class APIServer:
         # the cacher's per-watcher channel size; KTPU_WATCH_BUFFER env
         # inside Storage otherwise): a consumer that stops draining is
         # evicted with a too-old error, never allowed to balloon memory
-        self.storage = storage or Storage(watch_buffer=watch_buffer)
+        # data_dir (or KTPU_STORE_DIR) makes the control plane durable:
+        # boot-time recovery replays snapshot + WAL tail BEFORE the first
+        # request is served, so a rebooted apiserver answers with revisions
+        # that continue the pre-crash sequence (ISSUE 19)
+        if data_dir is None:
+            data_dir = os.environ.get("KTPU_STORE_DIR") or None
+        self.storage = storage or Storage(watch_buffer=watch_buffer,
+                                          data_dir=data_dir,
+                                          durability=durability)
         self.scheme = scheme or build_scheme()
         if admission is None:
             admission = AdmissionChain()

@@ -7,10 +7,13 @@ staging/src/k8s.io/apiserver/pkg/storage/etcd3/). The store itself is C++
 
 from kubernetes_tpu.storage.native import (
     CompactedError,
+    DurableKV,
     NativeKV,
     PyKV,
     new_kv,
 )
 from kubernetes_tpu.storage.store import Storage
+from kubernetes_tpu.storage.wal import WalCorruptionError, WalWriteError
 
-__all__ = ["CompactedError", "NativeKV", "PyKV", "new_kv", "Storage"]
+__all__ = ["CompactedError", "DurableKV", "NativeKV", "PyKV", "new_kv",
+           "Storage", "WalCorruptionError", "WalWriteError"]

@@ -129,8 +129,16 @@ class Storage:
     """Object store + watch hub over one KV backend."""
 
     def __init__(self, kv=None, watch_buffer: Optional[int] = None,
-                 bookmark_interval: Optional[float] = None):
-        self.kv = kv if kv is not None else native.new_kv()
+                 bookmark_interval: Optional[float] = None,
+                 data_dir: Optional[str] = None,
+                 durability: Optional[str] = None):
+        # data_dir turns the store durable: the kv is wrapped in the
+        # WAL/snapshot layer (storage/wal.py) and recovery has ALREADY run
+        # by the time new_kv returns — self.kv.rev() below is the last
+        # durable revision, so the pump, the cacher horizon and every RV
+        # this process hands out continue the pre-crash sequence
+        self.kv = kv if kv is not None else native.new_kv(
+            data_dir=data_dir, durability=durability)
         self._watch_mu = threading.Lock()
         self._watchers: List[_Watcher] = []
         self._watch_buffer = _parse_watch_buffer(

@@ -20,6 +20,12 @@ Spec grammar (comma-separated entries in ``FAULT_SPEC``)::
                | float in (0,1)    fire with that probability per call
                                    (seeded RNG — FAULT_SEED, default 0)
                | int N             fire exactly on the N-th call, any site
+               | site              fire on every call at that site — the
+                                   count is split off the RIGHT, so sites
+                                   may themselves contain colons
+                                   (proc.crash@wal:post_append:1 = the
+                                   first hit at site "wal:post_append";
+                                   wal.torn@tail = every hit at "tail")
     (no qualifier)                 fire on every call (e.g. native.dlopen)
 
 Seams wired in this repo (fault name → injection point):
@@ -99,6 +105,34 @@ Seams wired in this repo (fault name → injection point):
                                               deterministic single-mux
                                               kill; "stream" is the
                                               shared any-mux site
+    proc.crash@wal:{pre_fsync,                storage/wal.py append (pre/post
+      post_fsync,post_append}                 fsync) + storage/native.py
+                                              DurableKV commit (post_append):
+                                              the APISERVER dies mid-commit —
+                                              record appended / durable /
+                                              applied-to-memory respectively.
+                                              All three leave the record in
+                                              the WAL, so the cold-restart
+                                              drill's reboot replays it
+                                              (committed-but-unacked writes
+                                              may surface after reboot;
+                                              acknowledged ones may never be
+                                              lost)
+    wal.torn@tail                             storage/wal.py load_state:
+                                              bytes chopped off the FINAL
+                                              segment before replay — the
+                                              power cut landed mid-append;
+                                              recovery truncates the torn
+                                              frame and continues (the
+                                              clean-truncate row of the
+                                              decision table)
+    disk.full@wal                             storage/wal.py append: the
+                                              append is refused
+                                              (WalWriteError) BEFORE any
+                                              bytes land, so the in-memory
+                                              store and the log never
+                                              disagree; the caller sees a
+                                              failed write, not a torn one
     tenant.storm                              fleet/server.py per-tenant
                                               tick (site = tenant name,
                                               e.g. "tenant.storm@t02:1+"):
@@ -172,25 +206,33 @@ def parse_spec(spec: str) -> List[_Rule]:
         if not qual:
             rules.append(_Rule(fault=fault, always=True))
         elif ":" in qual:
-            site, _, n = qual.partition(":")
+            # the count splits off the RIGHT so sites may contain colons
+            # (proc.crash@wal:post_append:1); a qualifier whose final
+            # segment is not a count is a bare colon-bearing SITE
+            # (proc.crash@wal:post_append = always at that site)
+            site, _, n = qual.rpartition(":")
             persistent = n.endswith("+")
             n = n[:-1] if persistent else n
             try:
                 nth = int(n)
             except ValueError:
-                raise FaultSpecError(
-                    f"bad hit count {n!r} in {entry!r}") from None
-            rules.append(_Rule(fault=fault, site=site.strip(), nth=nth,
-                               persistent=persistent))
+                rules.append(_Rule(fault=fault, site=qual.strip(),
+                                   always=True))
+            else:
+                rules.append(_Rule(fault=fault, site=site.strip(), nth=nth,
+                                   persistent=persistent))
         elif _FLOAT_RE.match(qual):
             rules.append(_Rule(fault=fault, prob=float(qual)))
         else:
             try:
                 nth = int(qual)
             except ValueError:
-                raise FaultSpecError(
-                    f"bad qualifier {qual!r} in {entry!r}") from None
-            rules.append(_Rule(fault=fault, nth=nth))
+                # a bare site name (wal.torn@tail, disk.full@wal):
+                # fire on every should() call naming that site
+                rules.append(_Rule(fault=fault, site=qual.strip(),
+                                   always=True))
+            else:
+                rules.append(_Rule(fault=fault, nth=nth))
     return rules
 
 

@@ -248,6 +248,31 @@ int64_t kv_wait(void* h, int64_t rev, int64_t timeout_ms) {
   return s->rev;
 }
 
+// Install one record WITHOUT bumping the revision or appending an event —
+// snapshot restore only. The caller (the WAL recovery path) owns revision
+// bookkeeping via kv_init; feeding live traffic through here would corrupt
+// MVCC history.
+void kv_load(void* h, const char* key, const char* val, int64_t val_len,
+             int64_t create_rev, int64_t mod_rev) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  ValueRec& r = s->data[key];
+  r.value.assign(val, static_cast<size_t>(val_len));
+  r.create_rev = create_rev;
+  r.mod_rev = mod_rev;
+}
+
+// Seed the revision counter + compaction floor from durable state (snapshot
+// header). Recovery calls this BEFORE replaying the WAL tail, so replayed
+// mutations re-earn exactly the revisions they held before the crash — the
+// RV-continuity invariant.
+void kv_init(void* h, int64_t rev, int64_t compacted_rev) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->rev = rev;
+  s->compacted_rev = compacted_rev;
+}
+
 // Drop events with rev <= at_rev (etcd compaction).
 int64_t kv_compact(void* h, int64_t at_rev) {
   Store* s = static_cast<Store*>(h);

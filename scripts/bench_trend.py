@@ -15,6 +15,12 @@ ratios, proof counters) regresses DOWN. `pods_per_sec` is always checked
 absolute budgets in bench.py remain the hard floor — this gate catches
 drift BETWEEN runs that stays inside them).
 
+The durability stage (ISSUE 19) rides the same machinery: its
+`recovery_seconds` and `wal_write_overhead_pct` are time-like (gated
+within a backend, informational across backends), while `rv_continuity`,
+`torn_tail_ok`, and `recovered_objects` are invariants that gate on every
+backend.
+
 Usage:
     python scripts/bench_trend.py [--dir REPO] [--tolerance 0.25]
     python bench.py --trend [same flags]

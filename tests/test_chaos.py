@@ -546,7 +546,12 @@ def test_faultline_spec_grammar():
     fired = sum(fl.should("watch.drop") for _ in range(100))
     assert 20 < fired < 80
     assert fl.fired("device.hang") == 1
-    with pytest.raises(faultline.FaultSpecError):
-        faultline.parse_spec("bad@cycle:x")
+    # a qualifier whose final segment is not a count is a colon-bearing
+    # SITE (ISSUE 19 seam grammar: proc.crash@wal:post_append fires on
+    # every hit at site "wal:post_append"; the count splits off the RIGHT)
+    [r] = faultline.parse_spec("f@cycle:x")
+    assert (r.site, r.always) == ("cycle:x", True)
+    [r] = faultline.parse_spec("proc.crash@wal:post_append:2")
+    assert (r.site, r.nth) == ("wal:post_append", 2)
     with pytest.raises(faultline.FaultSpecError):
         faultline.parse_spec("@0.5")

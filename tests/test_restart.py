@@ -84,13 +84,34 @@ class DurableBinder:
 
 class Cluster:
     """One durable 'etcd' (Storage) + Binding registry + informer truth,
-    shared by every scheduler incarnation of a drill."""
+    shared by every scheduler incarnation of a drill.
 
-    def __init__(self, n_nodes=N_NODES, n_pods=N_PODS):
-        self.storage = Storage(kv=PyKV())
+    With ``data_dir`` the store is WAL-backed (ISSUE 19): the APISERVER
+    itself can now die in a drill, and ``reboot_storage`` brings up a fresh
+    incarnation recovered from disk — in-memory state is lost, the log is
+    not."""
+
+    def __init__(self, n_nodes=N_NODES, n_pods=N_PODS, data_dir=None,
+                 durability="always"):
+        self.data_dir = data_dir
+        self.durability = durability
+        self.storage = self._open_storage()
         self.binder = DurableBinder()
         self.nodes = [mknode(f"n{i}") for i in range(n_nodes)]
         self.pods = {f"default/p{i}": mkpod(f"p{i}") for i in range(n_pods)}
+
+    def _open_storage(self):
+        if self.data_dir is None:
+            return Storage(kv=PyKV())
+        return Storage(data_dir=self.data_dir, durability=self.durability)
+
+    def reboot_storage(self):
+        """The apiserver process is dead: quiesce the corpse's pump thread
+        (a real SIGKILL flushes nothing) and recover a new store from the
+        WAL on disk."""
+        self.storage._stop.set()
+        self.storage = self._open_storage()
+        return self.storage
 
     def close(self):
         self.storage.close()
@@ -212,6 +233,89 @@ def test_crash_during_takeover_second_successor_finishes():
 
         # second successor: replay sees whatever the first committed as
         # already_bound, completes the rest, retires the record
+        s3 = cluster.boot()
+        report = s3.recover(lookup=cluster.lookup)
+        assert report.replayed_intents == 1
+        s3.run_until_idle()
+        cluster.assert_exactly_once(s3)
+    finally:
+        cluster.close()
+
+
+# --------------------------------------------------------------------- #
+# the apiserver-death matrix (ISSUE 19): the STORE dies mid-commit
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.durability
+@pytest.mark.parametrize("site", [
+    "wal:pre_fsync",    # record written, not yet durable (page cache)
+    "wal:post_fsync",   # record durable, not yet applied to memory
+    "wal:post_append",  # record durable AND applied, ack never returned
+])
+def test_apiserver_death_matrix_reboot_reconciles(site, tmp_path):
+    """The apiserver dies inside the WAL commit of the wave's intent
+    record. Process death (not machine death) leaves the appended bytes in
+    the log at ALL three sites, so the rebooted store must surface the
+    intent — committed-but-unacked writes may appear after reboot, and the
+    successor's replay finishes the wave exactly-once."""
+    cluster = Cluster(data_dir=str(tmp_path / "etcd"))
+    try:
+        s1 = cluster.boot()
+        faultline.install(f"proc.crash@{site}:1")
+        with pytest.raises(faultline.InjectedCrash):
+            s1.schedule_pending()
+        faultline.uninstall()
+        assert len(cluster.binder.bound) == 0
+
+        # reboot the apiserver from disk: the intent record survived the
+        # kill regardless of whether its fsync or apply had happened
+        cluster.reboot_storage()
+        assert cluster.storage.kv.recovered
+        assert len(BindIntentLedger(cluster.storage).unretired()) == 1
+
+        s2 = cluster.boot()
+        report = s2.recover(lookup=cluster.lookup)
+        assert report.replayed_intents == 1
+        s2.run_until_idle()
+        cluster.assert_exactly_once(s2)
+    finally:
+        cluster.close()
+
+
+@pytest.mark.durability
+def test_double_kill_apiserver_then_takeover_crash(tmp_path):
+    """The compound drill: the apiserver dies mid-commit, and then the
+    FIRST successor scheduler dies mid-takeover while the rebooted store is
+    barely back. A second store reboot replays the same WAL again
+    (recovery is idempotent) and the third scheduler incarnation finishes
+    to exactly-once."""
+    cluster = Cluster(data_dir=str(tmp_path / "etcd"))
+    try:
+        s1 = cluster.boot()
+        faultline.install("proc.crash@wal:post_append:1")
+        with pytest.raises(faultline.InjectedCrash):
+            s1.schedule_pending()
+        faultline.uninstall()
+
+        cluster.reboot_storage()
+        assert len(BindIntentLedger(cluster.storage).unretired()) == 1
+
+        # first successor crashes INSIDE its reconciliation pass
+        s2 = cluster.boot()
+        faultline.install("proc.crash@takeover:1")
+        with pytest.raises(faultline.InjectedCrash):
+            s2.recover(lookup=cluster.lookup)
+        faultline.uninstall()
+
+        # ... and the apiserver dies AGAIN before anyone retires the
+        # intent: the second recovery replays the same log to the same
+        # revisions (plus whatever the crashed takeover committed)
+        rev_before = cluster.storage.kv.rev()
+        cluster.reboot_storage()
+        assert cluster.storage.kv.rev() == rev_before
+        assert len(BindIntentLedger(cluster.storage).unretired()) == 1
+
         s3 = cluster.boot()
         report = s3.recover(lookup=cluster.lookup)
         assert report.replayed_intents == 1
