@@ -497,7 +497,14 @@ class DurableKV:
             rev = b.rev() + 1
             self._wal.append(wal.OP_PUT, rev, key, value)
             got = b.txn_put(key, expected_mod_rev, value)
-            assert got == rev, f"wal/backend rev skew: {got} != {rev}"
+            if got != rev:
+                # not an assert: this invariant must hold under python -O
+                # too — a skew means the WAL logged one revision while the
+                # backend assigned another, corrupting replay and every
+                # resume token in the fleet
+                raise wal.WalCorruptionError(
+                    f"wal/backend rev skew on put {key!r}: "
+                    f"logged {rev}, backend assigned {got}")
             # the record is durable AND applied — the site a mid-commit
             # apiserver kill exercises in the cold-restart drill
             faultline.crashpoint("wal:post_append")
@@ -516,7 +523,10 @@ class DurableKV:
             rev = b.rev() + 1
             self._wal.append(wal.OP_DELETE, rev, key, b"")
             got = b.txn_delete(key, expected_mod_rev)
-            assert got == rev, f"wal/backend rev skew: {got} != {rev}"
+            if got != rev:
+                raise wal.WalCorruptionError(
+                    f"wal/backend rev skew on delete {key!r}: "
+                    f"logged {rev}, backend assigned {got}")
             faultline.crashpoint("wal:post_append")
             self._maybe_snapshot_locked()
             return rev

@@ -78,7 +78,7 @@ WAL_FSYNCS = _REG.counter(
     "apiserver_storage_wal_fsyncs_total",
     "fsync calls issued by the WAL, by trigger (commit = the `always` "
     "policy's per-acknowledgement sync, batch = the group-commit flusher, "
-    "rotate, snapshot)",
+    "rotate, snapshot, dir = directory-entry sync after create/rename)",
     labels=("trigger",))
 WAL_SNAPSHOTS = _REG.counter(
     "apiserver_storage_wal_snapshots_total",
@@ -182,6 +182,21 @@ def frame(payload: bytes) -> bytes:
 # segment / snapshot files
 # --------------------------------------------------------------------- #
 
+def _fsync_dir(path: str) -> None:
+    """Make directory entries durable. fsync on a file persists its bytes,
+    not the name pointing at them: a rename/create is only crash-safe once
+    the directory itself is synced."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds; nothing we can do
+    try:
+        os.fsync(fd)
+        WAL_FSYNCS.inc(trigger="dir")
+    finally:
+        os.close(fd)
+
+
 def _seg_name(seq: int) -> str:
     return f"wal-{seq:08d}.log"
 
@@ -278,6 +293,10 @@ def write_snapshot(data_dir: str, rev: int, compacted: int,
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    # the rename is only durable once the directory entry is — without this
+    # a machine death could persist the caller's subsequent unlinks of the
+    # old segments while losing the new snapshot: neither survives
+    _fsync_dir(data_dir)
     WAL_FSYNCS.inc(trigger="snapshot")
     WAL_SNAPSHOTS.inc()
     return path
@@ -343,8 +362,15 @@ def load_state(data_dir: str) -> RecoveredState:
         final = (i == len(segments) - 1)
         records, truncate_at = read_segment(path, final=final)
         if truncate_at is not None:
+            # POSIX truncate EXTENDS a shorter file: a final segment that
+            # died before its 16-byte header landed (truncate_at=0) must
+            # shrink to empty so the writer rewrites a valid header — padding
+            # it to SEG_HEADER_LEN zero bytes would make every subsequent
+            # acknowledged append sit behind a corrupt header and brick the
+            # NEXT boot
             with open(path, "r+b") as f:
-                f.truncate(max(truncate_at, SEG_HEADER_LEN))
+                f.truncate(truncate_at if truncate_at >= SEG_HEADER_LEN
+                           else 0)
             st.torn_tail_truncated = True
         st.wal_records.extend(records)
         st.next_seq = seq  # the writer re-opens the final segment for append
@@ -400,8 +426,18 @@ class WalWriter:
         existed = os.path.exists(path)
         self._f = open(path, "ab")
         if not existed or os.path.getsize(path) < SEG_HEADER_LEN:
+            # a partial header (crash between file creation and the 16th
+            # byte) is wiped, never appended-after: the header must start
+            # at offset 0
+            self._f.truncate(0)
             self._f.write(SEG_MAGIC + struct.pack("<q", seq))
             self._f.flush()
+            if not existed and self.durability != "off":
+                # the file's bytes fsync with the first record; its
+                # DIRECTORY ENTRY only becomes durable via the dir fd —
+                # without this, machine death after a rotation can lose a
+                # whole segment of acknowledged (file-fsynced) records
+                _fsync_dir(self.data_dir)
         self._seq = seq
         self._written = self._f.tell()
         self._synced = 0
@@ -474,6 +510,9 @@ class WalWriter:
         with self._mu:
             write_snapshot(self.data_dir, rev, compacted, records)
             self._rotate_locked()
+            # snapshot rename + fresh segment creation must BOTH be durable
+            # directory entries before any unlink below can land on disk
+            _fsync_dir(self.data_dir)
             keep_seq, keep_snap = self._seq, rev
         for seq, path in list_segments(self.data_dir):
             if seq < keep_seq:

@@ -28,6 +28,10 @@ Spec grammar (comma-separated entries in ``FAULT_SPEC``)::
                                    wal.torn@tail = every hit at "tail")
     (no qualifier)                 fire on every call (e.g. native.dlopen)
 
+    A qualifier segment that starts with a digit but parses as neither a
+    count nor a probability (``wal:0.5`` missing its site, ``3x``) raises
+    FaultSpecError instead of silently becoming a never-matching site.
+
 Seams wired in this repo (fault name → injection point):
 
     device.hang / device.error / device.oom   sched/supervisor.py (per-kind
@@ -181,6 +185,19 @@ class FaultSpecError(ValueError):
 _FLOAT_RE = re.compile(r"^0?\.\d+$|^0$|^1\.0$")
 
 
+def _reject_numeric_site(entry: str, seg: str) -> None:
+    """A would-be site segment that starts with a digit is a typo'd count or
+    probability (proc.crash@wal:0.5, fault@3x) — no wired seam site begins
+    with a digit. Installing it as an always-fire rule for a site that never
+    matches would let a chaos drill pass without injecting anything, so
+    refuse loudly instead."""
+    if seg and seg[0].isdigit():
+        raise FaultSpecError(
+            f"{entry!r}: qualifier segment {seg!r} looks numeric but is not "
+            "a valid count (N / N+) or probability in (0,1); site names "
+            "never start with a digit")
+
+
 @dataclass
 class _Rule:
     fault: str
@@ -216,6 +233,7 @@ def parse_spec(spec: str) -> List[_Rule]:
             try:
                 nth = int(n)
             except ValueError:
+                _reject_numeric_site(entry, n)
                 rules.append(_Rule(fault=fault, site=qual.strip(),
                                    always=True))
             else:
@@ -229,6 +247,7 @@ def parse_spec(spec: str) -> List[_Rule]:
             except ValueError:
                 # a bare site name (wal.torn@tail, disk.full@wal):
                 # fire on every should() call naming that site
+                _reject_numeric_site(entry, qual)
                 rules.append(_Rule(fault=fault, site=qual.strip(),
                                    always=True))
             else:

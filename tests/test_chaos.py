@@ -555,3 +555,11 @@ def test_faultline_spec_grammar():
     assert (r.site, r.nth) == ("wal:post_append", 2)
     with pytest.raises(faultline.FaultSpecError):
         faultline.parse_spec("@0.5")
+    # a qualifier segment that LOOKS numeric but parses as neither a count
+    # nor a probability is a typo (probability with a site, malformed N) —
+    # refused loudly, never installed as an always-fire rule for a site
+    # that can't exist (the drill would pass without injecting anything)
+    for bad in ("proc.crash@wal:0.5", "fault@3x", "watch.drop@1.5",
+                "device.hang@cycle:2x"):
+        with pytest.raises(faultline.FaultSpecError):
+            faultline.parse_spec(bad)

@@ -216,6 +216,33 @@ class TestSnapshots:
         assert kv2.rev() == 25
         kv2.close()
 
+    def test_snapshot_dir_entries_durable_before_pruning(self, tmp_path,
+                                                         monkeypatch):
+        """The snapshot rename and the fresh segment's creation must be
+        durable DIRECTORY entries before the old segments/snapshots are
+        unlinked — else machine death can persist the unlinks while losing
+        the rename, leaving neither the new snapshot nor the old WAL."""
+        events = []
+        real = wal._fsync_dir
+
+        def spy(path):
+            events.append(set(os.listdir(path)))
+            real(path)
+
+        monkeypatch.setattr(wal, "_fsync_dir", spy)
+        kv = _durable(tmp_path)
+        for i in range(3):
+            kv.put(f"/k{i}", b"v")
+        events.clear()
+        kv.snapshot()
+        # some dir sync observed BOTH the new snapshot and the doomed old
+        # segment: rename + rotation were durable before any unlink
+        assert any(
+            any(n.startswith("snap-") for n in ls)
+            and wal._seg_name(1) in ls and wal._seg_name(2) in ls
+            for ls in events)
+        kv.close()
+
     def test_corrupt_snapshot_refuses_boot(self, tmp_path):
         kv = _durable(tmp_path)
         kv.put("/k", b"v")
@@ -287,6 +314,33 @@ class TestRecoveryDecisionTable:
         with pytest.raises(wal.WalCorruptionError):
             _durable(tmp_path)
 
+    @pytest.mark.parametrize("junk", [b"", b"\x00" * 7,
+                                      wal.SEG_MAGIC[:4] + b"\x00"],
+                             ids=["empty", "zeros", "partial-magic"])
+    def test_headerless_final_segment_two_reboots(self, tmp_path, junk):
+        """Crash during rotation: the final segment was created but died
+        before its 16-byte header landed. Boot 2 must reset it to a valid
+        header — POSIX truncate EXTENDS a shorter file, so truncating "up"
+        to SEG_HEADER_LEN pads a corrupt header that boot 3 would refuse,
+        losing boot 2's acknowledged (fsynced) writes."""
+        kv = _durable(tmp_path)
+        kv.put("/k0", b"v")
+        kv.close()
+        d = str(tmp_path / "store")
+        with open(os.path.join(d, wal._seg_name(2)), "wb") as f:
+            f.write(junk)
+
+        kv2 = _durable(tmp_path)                 # boot 2
+        assert kv2.rev() == 1 and kv2.get("/k0") is not None
+        assert kv2.put("/k1", b"w") == 2         # acknowledged + fsynced
+        kv2.close()
+
+        kv3 = _durable(tmp_path)                 # boot 3
+        assert not kv3.torn_tail_truncated
+        assert kv3.rev() == 2
+        assert kv3.get("/k1").value == b"w"
+        kv3.close()
+
     def test_disk_full_refuses_append_memory_unchanged(self, tmp_path):
         kv = _durable(tmp_path)
         assert kv.put("/k0", b"v") == 1
@@ -302,6 +356,26 @@ class TestRecoveryDecisionTable:
         kv2 = _durable(tmp_path)
         assert kv2.rev() == 2
         kv2.close()
+
+
+class TestRevContinuityGuard:
+    def test_rev_skew_raises_even_under_optimize(self, tmp_path):
+        """The WAL/backend revision-continuity check must be a real raise,
+        not an `assert` that python -O compiles away: a skew logs one
+        revision while the backend assigns another, corrupting replay and
+        every resume token."""
+        kv = _durable(tmp_path)
+        assert kv.put("/k0", b"v") == 1
+        orig_put, orig_del = kv._backend.txn_put, kv._backend.txn_delete
+        kv._backend.txn_put = lambda *a: 999
+        with pytest.raises(wal.WalCorruptionError, match="rev skew"):
+            kv.put("/k1", b"v")
+        kv._backend.txn_put = orig_put
+        kv._backend.txn_delete = lambda *a: 999
+        with pytest.raises(wal.WalCorruptionError, match="rev skew"):
+            kv.txn_delete("/k0")
+        kv._backend.txn_delete = orig_del
+        kv.close()
 
 
 # --------------------------------------------------------------------- #
