@@ -58,6 +58,10 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
+# stdlib-only import (no jax): safe in the parent process, which must not
+# initialize a backend before the stage subprocesses pick theirs
+from kubernetes_tpu.utils.envparse import clamped_int, env_int  # noqa: E402
+
 REFERENCE_PODS_PER_SEC = 100.0
 
 # BASELINE.json configs 1-4: ramped so a top-shape failure still yields
@@ -102,6 +106,13 @@ DEFAULT_STAGES = [
                              # one vmap'd dispatch per tick, DRF quotas,
                              # zero cross-tenant placements (flagship
                              # target: 100 × 5k, docs/FLEET.md)
+    (2000, 2000, "fleet-flagship"),  # ISSUE 20: the largest fleet shape
+                                     # this box sustains — 24 tenants × 2k
+                                     # nodes × 2k pods on the 2-D
+                                     # (tenant × node-shard) mesh with
+                                     # MIXED per-tenant engines; one
+                                     # dispatch per engine group per tick,
+                                     # bit-equality vs per-tenant solo runs
     (250, 1250, "watchplane"),  # ISSUE 13: 16 tenants on ONE mux'd watch
                                 # stream per resource through a real
                                 # apiserver — a 10k ev/s storm with a
@@ -180,6 +191,14 @@ CYCLE_BUDGETS = {
     # virtual tenant mesh on CPU): the vmapped wave program over 16
     # stacked tenants — the cold compile is excluded (first tick)
     ("fleet", 1000): 300.0,
+    # worst steady fleet-flagship tick: 24 tenants × 2k nodes × 2k pods on
+    # the 2-D (4 tenant-rows × 2 node-shards) virtual mesh, three engine
+    # groups dispatched per tick. CPU-budgeted; the real-accelerator
+    # budget for the same shape is ~5 s/tick (the stage records it as
+    # real_accel_cycle_budget_s so a v5e-8 run trends against it, not
+    # against this host-collective number). Cold compiles (one per engine
+    # group) are excluded — first-tick cost, reported separately.
+    ("fleet-flagship", 2000): 480.0,
     # worst steady watchplane tick: 16 tenants' vmapped wave plus the
     # ingest path (apiserver → pump → mux → routes) running concurrently
     # on the same CPU box; the cold compile tick is excluded, and the
@@ -305,6 +324,27 @@ METRIC_BUDGETS = {
                       # shape while the feature under test does nothing
                       "drf_clamped": (">=", 1),
                       "tenants_lossless": (">=", 1)},
+    # ISSUE 20 acceptance: the flagship fleet shape evaluates as ONE XLA
+    # dispatch PER ENGINE GROUP per tick (mixed per-tenant engines — three
+    # groups — so dispatches/groups must be exactly 1), the 2-D mesh run
+    # is bit-equal to per-tenant SOLO single-device runs (one tenant per
+    # engine re-run in isolation; bit_equal_tenants_checked says how many
+    # were actually compared), nothing is lost or double-bound across the
+    # whole fleet, and the throughput floor keeps the stage a regression
+    # gate rather than a smoke test (pods_per_sec is fleet-wide bound
+    # pods over wall-clock; floor set ~40% under the measured CPU number)
+    ("fleet-flagship", 2000): {
+        "dispatches_per_engine_group": ("<=", 1.0),
+        "engine_groups": (">=", 3),
+        "bit_equal": (">=", 1),
+        "bit_equal_tenants_checked": (">=", 3),
+        "node_shards": (">=", 2),
+        "drf_violations": ("<=", 0),
+        "cross_tenant_placements": ("<=", 0),
+        "lost_pods": ("<=", 0),
+        "double_bound": ("<=", 0),
+        "tenants_lossless": (">=", 1),
+        "pods_per_sec": (">=", 100.0)},
     # ISSUE 13 acceptance: K tenants ride ONE upstream watch stream per
     # resource (not K); the storm — with a mid-storm compaction, a deaf
     # route, a mux-kill and an apiserver-restart drill — costs at most 2
@@ -363,9 +403,18 @@ def _stage_list():
     out = []
     for part in spec.split(","):
         bits = part.lower().split("x")
-        kind = bits[2] if len(bits) > 2 else "flagship"
-        out.append((int(bits[0]), int(bits[1]), kind))
-    return out
+        kind = bits[2].strip() if len(bits) > 2 else "flagship"
+        # bounds-checked shape parse: a garbage part must skip THAT stage
+        # with a note in the summary, not crash the whole bench before any
+        # stage ran (clamped_int's sentinel default exposes unparseable)
+        nodes = clamped_int(bits[0] if bits else None, 0, 0, 1_000_000)
+        pods = clamped_int(bits[1] if len(bits) > 1 else None,
+                           0, 0, 10_000_000)
+        if nodes <= 0 or pods <= 0:
+            print(f"# BENCH_STAGES: skipping unparseable part {part!r}")
+            continue
+        out.append((nodes, pods, kind))
+    return out or DEFAULT_STAGES
 
 
 def _cpu_env(env):
@@ -409,7 +458,7 @@ def _run_stage(n_nodes, n_pods, kind, env, timeout):
         # would be nondeterminism, not signal. The overload stage owns
         # the governor — and proves kill-switch bit-equality itself.
         env["KTPU_OVERLOAD"] = "0"
-    if kind in ("mesh", "multichip", "fleet") \
+    if kind in ("mesh", "multichip", "fleet", "fleet-flagship") \
             and os.environ.get("KTPU_MESH_STAGE_REAL") != "1":
         # the multichip stages run on an 8-way VIRTUAL CPU mesh (ISSUE 3:
         # --xla_force_host_platform_device_count=8) so the sharded serving
@@ -1420,7 +1469,7 @@ def _fleet_stage(n_nodes, n_pods):
     from kubernetes_tpu.sched.scheduler import RecordingBinder
     from kubernetes_tpu.state.dims import Dims, bucket
 
-    tenants = int(os.environ.get("KTPU_FLEET_TENANTS", "16"))
+    tenants = env_int("KTPU_FLEET_TENANTS", 16, 1, 1024)
     n_devices = len(jax.devices())
     mesh = min(8, n_devices) if n_devices >= 2 else None
     batch = min(4096, max(64, n_pods // 2))
@@ -1459,7 +1508,7 @@ def _fleet_stage(n_nodes, n_pods):
 
     ticks = []
     t0 = time.perf_counter()
-    max_ticks = int(os.environ.get("KTPU_FLEET_MAX_TICKS", "24"))
+    max_ticks = env_int("KTPU_FLEET_MAX_TICKS", 24, 1, 10000)
     for _ in range(max_ticks):
         c0 = time.perf_counter()
         tk = srv.tick()
@@ -1528,6 +1577,151 @@ def _fleet_stage(n_nodes, n_pods):
     }))
 
 
+def _fleet_flagship_stage(n_nodes, n_pods):
+    """ISSUE 20 flagship stage: the largest fleet shape this box sustains —
+    K tenants (default 24, KTPU_FLEET_FLAGSHIP_TENANTS) × n_nodes ×
+    n_pods each, multiplexed through ONE FleetServer on the 2-D
+    (tenant × node-shard) virtual mesh (KTPU_FLEET_NODE_SHARDS, default 2:
+    a 4×2 layout on 8 devices) with MIXED per-tenant engines — tenants
+    round-robin over waves/runs/scan, so every tick runs exactly one
+    vmap'd dispatch PER ENGINE GROUP. After the fleet run, one tenant per
+    engine is re-run SOLO (fresh single-device FleetServer, same nodes and
+    backlog) and its placements compared bit-for-bit; the honest scope of
+    that claim is recorded as bit_equal_tenants_checked. METRIC_BUDGETS
+    enforce dispatches/group == 1, three engine groups, bit-equality,
+    0 lost / 0 double-bound, and the pods/s floor. CPU-budgeted: the
+    real-accelerator tick budget for this shape rides along as
+    real_accel_cycle_budget_s rather than gating the virtual-mesh run."""
+    import jax
+
+    from kubernetes_tpu.api.types import Pod, Resources
+    from kubernetes_tpu.fleet import FleetServer
+    from kubernetes_tpu.models.workloads import make_nodes
+    from kubernetes_tpu.parallel.mesh import fleet_mesh_shape
+    from kubernetes_tpu.sched.scheduler import RecordingBinder
+    from kubernetes_tpu.state.dims import Dims, bucket
+
+    tenants = env_int("KTPU_FLEET_FLAGSHIP_TENANTS", 24, 1, 1024)
+    node_shards = env_int("KTPU_FLEET_NODE_SHARDS", 2, 1, 8)
+    max_ticks = env_int("KTPU_FLEET_MAX_TICKS", 24, 1, 10000)
+    n_devices = len(jax.devices())
+    mesh = min(8, n_devices) if n_devices >= 2 else None
+    names = [f"t{k:02d}" for k in range(tenants)]
+    engines = {n: FleetServer.ENGINES[k % len(FleetServer.ENGINES)]
+               for k, n in enumerate(names)}
+    batch = min(4096, max(64, n_pods // 2))
+    base = Dims(N=bucket(n_nodes), P=bucket(batch), E=bucket(n_pods + 256))
+    nodes = make_nodes(n_nodes)
+
+    def run(group, **srv_kwargs):
+        """One fleet run over `group` tenants; returns (srv, binders,
+        ticks, t_total, t_ingest). Solo reruns call this with a single
+        tenant and mesh=None — same ingest, same tick loop, no mesh."""
+        clk = {"t": 0.0}
+        srv = FleetServer(batch_size=batch, base_dims=base,
+                          clock=lambda: clk["t"], **srv_kwargs)
+        srv.prewarmer.enabled = False  # steady ticks, no background compile
+        binders = {}
+        t0 = time.perf_counter()
+        for name in group:
+            b = RecordingBinder()
+            binders[name] = b
+            t = srv.add_tenant(name, binder=b)
+            for n in nodes:
+                t.on_node_add(n)
+            for i in range(n_pods):
+                t.on_pod_add(Pod(name=f"{name}-p{i}",
+                                 requests=Resources.make(cpu="20m",
+                                                         memory="16Mi"),
+                                 creation_index=i))
+        t_ingest = time.perf_counter() - t0
+        ticks = []
+        t0 = time.perf_counter()
+        for _ in range(max_ticks):
+            c0 = time.perf_counter()
+            tk = srv.tick()
+            clk["t"] += 1.0
+            ticks.append((time.perf_counter() - c0, tk))
+            done = all(t.sched.queue.lengths()[0] == 0
+                       for t in srv.tenants.values())
+            if done or (tk.scheduled == 0 and len(ticks) > 2):
+                break
+        return srv, binders, ticks, time.perf_counter() - t0, t_ingest
+
+    srv, binders, ticks, t_total, t_ingest = run(
+        names, mesh=mesh, node_shards=node_shards, engines=engines)
+
+    # ---- loss / duplication math (per tenant; queued ≠ lost) ---------- #
+    per_tenant_bound = {n: len(b.bound) for n, b in binders.items()}
+    scheduled = sum(per_tenant_bound.values())
+    lost_by_tenant = {}
+    double = 0
+    still_queued = 0
+    for name, b in binders.items():
+        keys = [k for k, _ in b.bound]
+        double += len(keys) - len(set(keys))
+        q = sum(srv.tenant(name).sched.queue.lengths())
+        still_queued += q
+        lost_by_tenant[name] = n_pods - len(set(keys)) - q
+    lost = sum(lost_by_tenant.values())
+
+    # ---- bit-equality vs per-tenant SOLO runs: one tenant per engine -- #
+    # (fresh single-device FleetServer per tenant — the 2-D-sharded mixed-
+    # engine fleet must reproduce each solo run's placements exactly)
+    checked = names[:min(len(FleetServer.ENGINES), tenants)]
+    bit_equal_by_tenant = {}
+    for name in checked:
+        _, solo_binders, _, _, _ = run(
+            [name], mesh=None, engines={name: engines[name]})
+        bit_equal_by_tenant[name] = int(
+            sorted(solo_binders[name].bound) == sorted(binders[name].bound))
+
+    steady = [w for w, _ in ticks[1:]] or [ticks[0][0]]
+    mesh_shape = list(fleet_mesh_shape(srv.mesh)) if srv.mesh else [1, 1]
+    groups = srv.max_engine_groups
+    print(json.dumps({
+        "nodes": n_nodes, "pods": n_pods, "kind": "fleet-flagship",
+        "tenants": tenants, "n_devices": n_devices,
+        "mesh_shape": mesh_shape,
+        "node_shards": mesh_shape[1],
+        "engine_mix": {e: sum(1 for v in engines.values() if v == e)
+                       for e in FleetServer.ENGINES},
+        "stack_k": {e: s.K for e, s in sorted(srv.stacks.items())},
+        "scheduled": scheduled,
+        "failed": max(tenants * n_pods - scheduled - still_queued, 0),
+        "queued": still_queued,
+        "cycle_seconds": round(max(steady), 3),
+        "median_cycle_seconds": round(sorted(steady)[len(steady) // 2], 3),
+        "cold_tick_seconds": round(ticks[0][0], 3),
+        "real_accel_cycle_budget_s": 5.0,
+        "ticks": len(ticks),
+        "ingest_seconds": round(t_ingest, 2),
+        "fleet_dispatches_per_tick": srv.max_dispatches_per_tick,
+        "engine_groups": groups,
+        # exactly 1.0 when every tick ran one dispatch per engine group —
+        # a retry or a split group shows up as > 1 here
+        "dispatches_per_engine_group": round(
+            srv.max_dispatches_per_tick / max(groups, 1), 3),
+        "drf_violations": srv.total_drf_violations,
+        "cross_tenant_placements": srv.total_cross_tenant,
+        "full_restacks": {e: s.full_restacks
+                          for e, s in sorted(srv.stacks.items())},
+        "donated_patches": sum(s.donated_patches
+                               for s in srv.stacks.values()),
+        "donation_failures": sum(s.donation_failures
+                                 for s in srv.stacks.values()),
+        "lost_pods": lost,
+        "double_bound": double,
+        "tenants_lossless": int(all(v == 0
+                                    for v in lost_by_tenant.values())),
+        "bit_equal": int(all(bit_equal_by_tenant.values())),
+        "bit_equal_tenants_checked": len(bit_equal_by_tenant),
+        "bit_equal_by_tenant": bit_equal_by_tenant,
+        "pods_per_sec": round(scheduled / t_total, 1) if t_total else 0.0,
+        "backend": jax.default_backend(),
+    }))
+
+
 def _watchplane_stage(n_nodes, n_pods):
     """ISSUE 13 acceptance stage: the fleet watch plane under storm. K
     virtual tenants (default 16, KTPU_FLEET_TENANTS) ride ONE multiplexed
@@ -1557,7 +1751,7 @@ def _watchplane_stage(n_nodes, n_pods):
     from kubernetes_tpu.state.dims import Dims, bucket
     from kubernetes_tpu.utils import faultline
 
-    tenants = int(os.environ.get("KTPU_FLEET_TENANTS", "16"))
+    tenants = env_int("KTPU_FLEET_TENANTS", 16, 1, 1024)
     rate = float(os.environ.get("KTPU_WATCHPLANE_EVENTS_PER_S", "10000"))
     total_events = tenants * n_pods
     names = [f"t{k:02d}" for k in range(tenants)]
@@ -2788,6 +2982,9 @@ def _stage_main(n_nodes, n_pods, kind):
     if kind == "fleet":
         _fleet_stage(n_nodes, n_pods)
         return
+    if kind == "fleet-flagship":
+        _fleet_flagship_stage(n_nodes, n_pods)
+        return
     if kind == "watchplane":
         _watchplane_stage(n_nodes, n_pods)
         return
@@ -2967,6 +3164,10 @@ def _compact_line(full, out_name, wrote):
                 e["disp_per_tick"] = r.get("fleet_dispatches_per_tick")
                 e["drf_viol"] = r.get("drf_violations")
                 e["cross_tenant"] = r.get("cross_tenant_placements")
+            if r.get("kind") == "fleet-flagship":
+                e["pods_per_sec"] = r.get("pods_per_sec")
+                e["disp_per_group"] = r.get("dispatches_per_engine_group")
+                e["bit_equal"] = r.get("bit_equal")
             if r.get("kind") == "latency":
                 e["p50_ms"] = r.get("p50_ms")
                 e["p99_ms"] = r.get("p99_ms")
@@ -3034,9 +3235,9 @@ def _emit_summary(results, backend, probe_diags):
 
 def main():
     t_start = time.perf_counter()
-    total_budget = int(os.environ.get("BENCH_TOTAL_BUDGET", "1200"))
+    total_budget = env_int("BENCH_TOTAL_BUDGET", 1200, 1, 86400)
     stages = _stage_list()
-    stage_timeout = int(os.environ.get("BENCH_STAGE_TIMEOUT", "1200"))
+    stage_timeout = env_int("BENCH_STAGE_TIMEOUT", 1200, 1, 86400)
 
     results = []
     state = {"backend": "unknown", "probe": []}

@@ -355,7 +355,10 @@ class FleetTickStats:
     bench budgets enforce."""
 
     per_tenant: Dict[str, CycleStats] = field(default_factory=dict)
-    dispatches: int = 0               # XLA dispatches this tick (budget: 1)
+    dispatches: int = 0               # XLA dispatches this tick (budget:
+                                      # one per ENGINE GROUP — 1 for a
+                                      # uniform-engine fleet)
+    engine_groups: int = 0            # distinct per-tenant engines this tick
     drf_violations: int = 0           # tenants whose admitted demand broke
                                       # their headroom (budget: 0)
     drf_clamped: int = 0              # pods deferred by the quota pre-mask
@@ -381,21 +384,45 @@ class FleetServer:
     """One resident scheduler serving K virtual tenant clusters per vmap'd
     tick. See the module docstring for the ownership model."""
 
+    #: the engines a per-tenant config may name (the lattice the
+    #: single-cluster KTPU_ASSIGN knob normalizes into)
+    ENGINES = ("waves", "runs", "scan")
+
     def __init__(self, batch_size: int = 1024,
                  base_dims: Optional[Dims] = None, mesh=None,
+                 node_shards: Optional[int] = None,
+                 engines: Optional[Dict[str, str]] = None,
                  clock: Callable[[], float] = time.monotonic,
                  scheduler_name: str = "default-scheduler",
                  storage=None):
         from ..sched.prewarm import BucketPrewarmer
         from ..sched.supervisor import DispatchSupervisor
+        from ..utils.envparse import env_int
 
         self.batch_size = batch_size
         self.clock = clock
         self.scheduler_name = scheduler_name
         self.storage = storage
-        self.mesh = self._make_fleet_mesh(mesh)
+        # per-tenant engine config: tenants grouped by engine run as
+        # sub-dispatches of the same tick (one vmap'd dispatch per GROUP);
+        # unlisted tenants follow the fleet default (KTPU_ASSIGN). Unlike
+        # the env knob — which normalizes garbage to "waves" — an explicit
+        # config naming an unknown engine is a caller bug and raises.
+        engines = dict(engines or {})
+        bad = {n: e for n, e in engines.items() if e not in self.ENGINES}
+        if bad:
+            raise ValueError(
+                f"unknown engine(s) in per-tenant config: {bad!r} — "
+                f"valid engines: {self.ENGINES}")
+        self.engines: Dict[str, str] = engines
+        if node_shards is None:
+            node_shards = env_int("KTPU_FLEET_NODE_SHARDS", 1, 1, 64)
+        self.node_shards = int(node_shards)
+        self.mesh, self.mesh_state = self._make_fleet_mesh(
+            mesh, self.node_shards)
         self.prewarmer = BucketPrewarmer()
-        self.supervisor = DispatchSupervisor(prewarmer=self.prewarmer)
+        self.supervisor = DispatchSupervisor(prewarmer=self.prewarmer,
+                                             mesh_state=self.mesh_state)
         self.prewarmer.supervisor = self.supervisor
         # fleet-level flight recorder (sched/telemetry.py): per-tick phase
         # spans + per-TENANT stats on each record; storms and abandoned
@@ -405,7 +432,9 @@ class FleetServer:
 
         self.telemetry = SchedulerTelemetry(name="fleet")
         self.supervisor.event_sink = self.telemetry.note_supervisor_event
-        self.stack = FleetStack(mesh=self.mesh)
+        # one resident FleetStack PER ENGINE GROUP, created lazily — a
+        # uniform-engine fleet (the common case) holds exactly one
+        self.stacks: Dict[str, FleetStack] = {}
         self._fleet_dims: Dims = replace(base_dims or Dims(),
                                          has_node_name=False)
         self.tenants: Dict[str, FleetTenant] = {}
@@ -415,9 +444,13 @@ class FleetServer:
         self.total_cross_tenant = 0
         self.total_drf_clamped = 0
         self.max_dispatches_per_tick = 0
+        self.max_engine_groups = 1
         self._super_epoch = self._supervisor_epoch()
-        # re-admission rewarm must target the FLEET mesh's executable key
-        # (the supervisor has no node-axis mesh_state here)
+        # re-admission rewarm must target the FLEET mesh's executable key.
+        # With a fleet-mode MeshState attached the supervisor reforms the
+        # (possibly 2-D) fleet mesh itself — the degrade→reform ladder under
+        # the 2-D signature; the provider remains the fallback for an
+        # adopted raw Mesh object (no MeshState to reform).
         self.supervisor.mesh_provider = lambda: self.mesh
         # ISSUE 13: the shared watch plane (attach_watch_plane) — one
         # multiplexed, bookmark-resumable stream per resource for all K
@@ -433,22 +466,77 @@ class FleetServer:
         return (st.degraded_cycles, st.abandoned, st.recoveries)
 
     @staticmethod
-    def _make_fleet_mesh(mesh):
+    def _make_fleet_mesh(mesh, node_shards: int = 1):
+        """→ (mesh, mesh_state). An int/str request builds a fleet-mode
+        MeshState (pow2 width, the degrade→reform ladder owns the mesh from
+        then on); a raw Mesh object is adopted as-is with no state to
+        reform. Garbage values clamp to "no mesh" — single-device serving —
+        instead of crashing int()."""
         if mesh is None or mesh == 0:
-            return None
+            return None, None
         from jax.sharding import Mesh
 
-        from ..parallel.mesh import make_fleet_mesh
+        from ..parallel.mesh import MeshState
+        from ..utils.envparse import clamped_int
 
         if isinstance(mesh, Mesh):
-            return mesh
-        n = int(mesh)
+            return mesh, None
+        n = clamped_int(mesh, 0, 0, 4096)
         if n <= 1:
-            return None
-        avail = len(jax.devices())
-        n = min(n, avail)
-        n = 1 << (max(n, 1).bit_length() - 1)   # pow2 floor, mesh discipline
-        return make_fleet_mesh(n) if n > 1 else None
+            return None, None
+        ns = clamped_int(node_shards, 1, 1, 64)
+        state = MeshState(n, fleet_node_shards=ns)
+        if state.mesh is None:
+            return None, None
+        return state.mesh, state
+
+    # ------------------------------------------------------------------ #
+    # per-engine-group residency
+    # ------------------------------------------------------------------ #
+
+    def _engine_for(self, name: str) -> str:
+        from ..sched.cycle import _engine
+
+        return self.engines.get(name) or _engine()
+
+    def _stack_for(self, engine: str) -> FleetStack:
+        st = self.stacks.get(engine)
+        if st is None:
+            st = self.stacks[engine] = FleetStack(mesh=self.mesh)
+        return st
+
+    @property
+    def stack(self) -> FleetStack:
+        """The default-engine group's stack — THE stack of a
+        uniform-engine fleet (back-compat accessor for tests/bench
+        reading restack/donation counters)."""
+        from ..sched.cycle import _engine
+
+        if len(self.stacks) == 1:
+            return next(iter(self.stacks.values()))
+        return self._stack_for(_engine())
+
+    def _invalidate_stacks(self) -> None:
+        for st in self.stacks.values():
+            st.invalidate()
+
+    def _node_shard_width(self) -> int:
+        if self.mesh is None:
+            return 1
+        from ..parallel.mesh import fleet_mesh_shape
+
+        return fleet_mesh_shape(self.mesh)[1]
+
+    def _sync_mesh(self) -> None:
+        """Adopt the MeshState's current mesh (degrade dropped it; reform
+        rebuilt it — possibly narrower, always a FRESH object). Every
+        group stack re-homes and full-restacks onto the new placement."""
+        if self.mesh_state is None or self.mesh_state.mesh is self.mesh:
+            return
+        self.mesh = self.mesh_state.mesh
+        for st in self.stacks.values():
+            st.mesh = self.mesh
+            st.invalidate()
 
     # ------------------------------------------------------------------ #
     # tenant lifecycle
@@ -519,6 +607,7 @@ class FleetServer:
 
         snaps: Dict[str, object] = {}
         keys: Dict[str, Tuple] = {}
+        kn = self._node_shard_width()
         for _ in range(4):
             for t in tlist:
                 pending = [p for p, _ in batches[t.name]]
@@ -528,6 +617,15 @@ class FleetServer:
                     device=self.supervisor.snapshot_device())
             union = fleet_dims([snaps[t.name].dims for t in tlist],
                                base=self._fleet_dims)
+            if kn > 1:
+                # 2-D mesh: the bucket's node axis must divide the
+                # node-shard row so the stacked [K, N, …] planes shard
+                # without padding. grown_for keeps N pow2 (≤256) or a
+                # ≥32-multiple above, so a pow2 row width makes this a
+                # no-op in the steady state; the guard covers raw shapes.
+                from ..parallel.mesh import padded_node_count
+
+                union = replace(union, N=padded_node_count(union.N, kn))
             if all(replace(snaps[t.name].dims, has_node_name=False)
                    == union for t in tlist):
                 self._fleet_dims = union
@@ -673,8 +771,10 @@ class FleetServer:
         except DispatchAbandonedError:
             # the abandoned worker's zombie thread may still hold (or be
             # executing on) the resident stacked buffers — never donate or
-            # scatter onto them again; the next healthy tick full-restacks
-            self.stack.invalidate()
+            # scatter onto them again; the next healthy tick full-restacks.
+            # Earlier engine groups' (uncommitted) results are discarded
+            # with the requeue: every popped pod goes back to its queue.
+            self._invalidate_stacks()
             self._requeue_batches(tlist, batches, tick, now)
             span.mark("requeue")
             tick.tick_seconds = time.perf_counter() - t0
@@ -684,16 +784,15 @@ class FleetServer:
             # any other post-pop failure (bucket non-convergence, a
             # donation assert in the stack refresh, an unexpected dispatch
             # error): requeue everything, drop the possibly half-patched
-            # stack, and re-raise for visibility
-            self.stack.invalidate()
+            # stacks, and re-raise for visibility
+            self._invalidate_stacks()
             self._requeue_batches(tlist, batches, tick, now)
             span.mark("requeue")
             tick.tick_seconds = time.perf_counter() - t0
             self._finish_tick(tick, span)
             raise
-        tick.dispatches += 1
 
-        self._commit_tick(out, tlist, batches, snaps, tick, now)
+        self._commit_tick(out, batches, snaps, tick, now)
         span.mark("bind-commit")
         tick.tick_seconds = time.perf_counter() - t0
         # per-tenant governor feedback: the shared tick's wall time is
@@ -730,10 +829,14 @@ class FleetServer:
                                                    now=now)
 
     def _dispatch_tick(self, tlist, batches, tick, now, span):
-        """Everything between the batch pop and the device result: the
-        snapshot convergence round, solo routing, resident stack refresh
-        and the ONE vmap'd dispatch. Raises propagate to tick()'s requeue
+        """Everything between the batch pop and the device results: the
+        snapshot convergence round, solo routing, per-engine-group resident
+        stack refresh and ONE vmap'd dispatch per engine group (exactly one
+        for a uniform-engine fleet). Raises propagate to tick()'s requeue
         guard — this method never loses a popped pod."""
+        # adopt a reformed/dropped mesh BEFORE snapshotting: the bucket's
+        # node-shard divisibility and the stacks' placement follow it
+        self._sync_mesh()
         snaps, keys = self._snapshot_round(tlist, batches)
         span.mark("snapshot")
 
@@ -793,29 +896,28 @@ class FleetServer:
             snaps, keys = self._snapshot_round(tlist, batches)
             span.mark("solo")
 
-        # ---- engine + shared static run bound ---- #
-        from ..sched.cycle import _engine, _resolve_rc
-
-        engine = _engine()
+        # ---- per-tenant engine grouping + shared static run bounds ---- #
         # no waves→scan downgrade here: nodeName-bearing batches were solo-
-        # routed above, so every snapshot entering the shared program has
+        # routed above, so every snapshot entering the shared programs has
         # has_node_name=False (re-snapshotted with an empty batch) — one
-        # tenant's pin must never serialize the other K-1 tenants
-        rc = 0
-        if engine == "runs":
-            for t in tlist:
-                sn = snaps[t.name]
-                rc = max(rc, _resolve_rc(sn.pending, sn.runs))
-                if sn.runs is not None:
-                    tick.per_tenant[t.name].class_runs = sn.runs.n_runs
+        # tenant's pin must never serialize the other K-1 tenants.
+        # Tenants group by their configured engine; each group is one
+        # sub-dispatch of this tick (one vmap'd program per group, so a
+        # runs tenant's static bound never recompiles the waves group).
+        groups: Dict[str, List] = {}
+        for t in tlist:
+            groups.setdefault(self._engine_for(t.name), []).append(t)
+        order = {e: i for i, e in enumerate(self.ENGINES)}
+        group_items = sorted(groups.items(),
+                             key=lambda kv: order.get(kv[0], len(order)))
+        tick.engine_groups = len(group_items)
 
-        # ---- resident stack refresh (donated per-tenant row patches) --- #
         d = self._fleet_dims
         if self.supervisor.healthy:
             epoch = self._supervisor_epoch()
             if epoch != self._super_epoch:
                 # the primary hung/failed or the backend was re-admitted
-                # since the stack's last refresh: a hung dispatch's
+                # since the stacks' last refresh: a hung dispatch's
                 # abandoned worker may STILL hold the resident buffers
                 # (handle.result() returned the fallback's answer without
                 # raising), and a sub-second probe can re-admit before the
@@ -823,20 +925,45 @@ class FleetServer:
                 # from under the wedged execution. Full-restack fresh
                 # instead (the fleet analog of the cache's
                 # _dispatch_inflight copy gate).
-                self.stack.invalidate()
+                self._invalidate_stacks()
                 self._super_epoch = epoch
-            Kp = self.stack.refresh([snaps[t.name] for t in tlist],
-                                    [keys[t.name] for t in tlist], d)
+
+        results: List[Tuple] = []
+        for engine, gts in group_items:
+            results.append(self._dispatch_group(
+                engine, gts, batches, snaps, keys, d, tick, span))
+        return results, snaps
+
+    def _dispatch_group(self, engine, gts, batches, snaps, keys, d, tick,
+                        span):
+        """One engine group's sub-dispatch: refresh ITS resident stack,
+        pad ITS quota vector, prewarm/sign under ITS fleet key, submit and
+        read back. Returns (gts, out, exp) for _commit_tick."""
+        from ..sched.cycle import _resolve_rc
+
+        rc = 0
+        if engine == "runs":
+            for t in gts:
+                sn = snaps[t.name]
+                rc = max(rc, _resolve_rc(sn.pending, sn.runs))
+                if sn.runs is not None:
+                    tick.per_tenant[t.name].class_runs = sn.runs.n_runs
+
+        # ---- resident stack refresh (donated per-tenant row patches) --- #
+        stack = self._stack_for(engine)
+        if self.supervisor.healthy:
+            Kp = stack.refresh([snaps[t.name] for t in gts],
+                               [keys[t.name] for t in gts], d)
         else:
             # degraded: the resident buffers live on the lost backend —
             # scattering onto them would dispatch onto dead hardware before
             # the supervisor's ladder even runs. Drop the stack (fresh
             # full restack on re-admission) and let the fallback re-encode
             # from host staging; submit() skips the primary while unhealthy.
-            self.stack.invalidate()
-            Kp = self.stack.padded_k(len(tlist))
+            stack.invalidate()
+            Kp = stack.padded_k(len(gts))
         span.mark("stack-refresh")
-        quota = jnp.asarray(self._pad_quota(tlist, Kp), jnp.float32)
+        quota = jnp.asarray(self._pad_quota(gts, Kp), jnp.float32)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -848,8 +975,8 @@ class FleetServer:
         # ---- compile-ahead + supervisor bookkeeping under the FLEET key - #
         fsig = fleet_signature(Kp)
         self.prewarmer.observe(
-            d, n_nodes=max(t.sched.cache.node_count for t in tlist),
-            n_existing=max(t.sched.cache.pod_count for t in tlist),
+            d, n_nodes=max(t.sched.cache.node_count for t in gts),
+            n_existing=max(t.sched.cache.pod_count for t in gts),
             engine=engine, mesh=self.mesh, rc=rc, fleet=fsig)
         self.prewarmer.ensure_warm(d, engine, mesh=self.mesh, rc=rc,
                                    fleet=fsig)
@@ -857,12 +984,11 @@ class FleetServer:
                                              fleet=fsig)
         span.mark("prewarm")
 
-        # ---- ONE vmap'd dispatch for the whole fleet ---- #
-        stack = self.stack
+        # ---- ONE vmap'd dispatch for this engine group ---- #
         # decision provenance (ISSUE 10): one flag for the whole stack —
         # tenants share the process env, and the vmap'd program is one
         # executable. Attribution fans back out per tenant in _commit_tick.
-        explain_on = any(t.sched.explainer is not None for t in tlist)
+        explain_on = any(t.sched.explainer is not None for t in gts)
 
         def _primary():
             if stack.block is None:
@@ -872,8 +998,8 @@ class FleetServer:
                 # _readmit flips health asynchronously): full-restack from
                 # THIS tick's snapshots instead of dereferencing the
                 # dropped buffers
-                stack.refresh([snaps[t.name] for t in tlist],
-                              [keys[t.name] for t in tlist], d)
+                stack.refresh([snaps[t.name] for t in gts],
+                              [keys[t.name] for t in gts], d)
             out = dispatch_fleet(stack.tables, stack.pending, stack.keys,
                                  d.D, stack.existing, engine, quota,
                                  rc=rc, dims=d, prewarmer=self.prewarmer,
@@ -883,15 +1009,15 @@ class FleetServer:
                 (jax.device_get(exp) if exp is not None else None)
 
         def _fallback(dev, hung=False):
-            # degraded fleet tick: re-encode every tenant onto the CPU
-            # fallback from host staging (the single-cluster ladder,
+            # degraded fleet tick: re-encode this group's tenants onto the
+            # CPU fallback from host staging (the single-cluster ladder,
             # per tenant) and dispatch the stack there — no resident
             # buffers of the lost backend are touched
             from ..sched.cycle import snapshot_with_keys
             from .tables import stack_blocks
 
             blocks = []
-            for t in tlist:
+            for t in gts:
                 sn, ky = snapshot_with_keys(
                     t.sched.cache, t.sched.encoder,
                     [p for p, _ in batches[t.name]], self._fleet_dims,
@@ -903,7 +1029,7 @@ class FleetServer:
 
                 blocks.extend([empty_tenant_block(d)] * (Kp - len(blocks)))
             tb, pe, ex, ky = jax.device_put(stack_blocks(blocks), dev)
-            q = jax.device_put(jnp.asarray(self._pad_quota(tlist, Kp),
+            q = jax.device_put(jnp.asarray(self._pad_quota(gts, Kp),
                                            jnp.float32), dev)
             with jax.default_device(dev):
                 out = dispatch_fleet(tb, pe, ky, d.D, ex, engine, q, rc=rc,
@@ -922,14 +1048,19 @@ class FleetServer:
         span.mark("dispatch")
         out, exp = handle.result()
         span.mark("readback")
-        return (out, exp), snaps
+        tick.dispatches += 1
+        return (gts, out, exp)
 
-    def _commit_tick(self, out, tlist, batches, snaps, tick, now) -> None:
+    def _commit_tick(self, results, batches, snaps, tick, now) -> None:
         """The per-tenant commit loops (PR 4 machinery per tenant): intent
         write → assume → fenced bind → retire, through each tenant's own
-        Scheduler, plus the DRF violation check over the dispatch's own
-        outputs."""
-        out, exp = out
+        Scheduler, plus the DRF violation check over each sub-dispatch's
+        own outputs."""
+        for gts, out, exp in results:
+            self._commit_group(gts, out, exp, batches, snaps, tick, now)
+
+    def _commit_group(self, tlist, out, exp, batches, snaps, tick,
+                      now) -> None:
         node = np.asarray(out.node)
         admitted = np.asarray(out.admitted)
         share = np.asarray(out.share)
@@ -1041,6 +1172,8 @@ class FleetServer:
         self.total_drf_clamped += tick.drf_clamped
         self.max_dispatches_per_tick = max(self.max_dispatches_per_tick,
                                            tick.dispatches)
+        self.max_engine_groups = max(self.max_engine_groups,
+                                     tick.engine_groups)
         # per-tenant attribution happens INSIDE observe_fleet_tick now:
         # the chaos suite and bench assert tenant isolation (and the DRF
         # clamp) from the tenant-labelled metrics, routed through
@@ -1058,6 +1191,7 @@ class FleetServer:
                               "aborted": st.aborted}
                        for name, st in tick.per_tenant.items()},
                 extra={"dispatches": tick.dispatches,
+                       "engine_groups": tick.engine_groups,
                        "drf_violations": tick.drf_violations,
                        "cross_tenant_placements":
                            tick.cross_tenant_placements})
@@ -1076,6 +1210,7 @@ class FleetServer:
             tk = self.tick()
             stalled = stalled + 1 if tk.scheduled == 0 else 0
             total.dispatches += tk.dispatches
+            total.engine_groups = max(total.engine_groups, tk.engine_groups)
             total.drf_violations += tk.drf_violations
             total.drf_clamped += tk.drf_clamped
             total.cross_tenant_placements += tk.cross_tenant_placements

@@ -12,8 +12,10 @@ already proves for the node axis — unoccupied slots are inert rows
 applied here by the encoder's own bucketed staging, one axis at a time.
 
 `FleetStack` keeps the STACKED trees resident on device (optionally sharded
-across a tenant-axis mesh — each chip owns whole tenants, so the fleet
-cycle needs no cross-chip collectives): a tenant whose snapshot object
+across a fleet mesh — 1-D: each chip owns whole tenants, no collectives;
+2-D `(TENANT_AXIS, NODE_AXIS)`: each tenant's node planes additionally
+split across a device row, with cross-row argmax/psum inserted by GSPMD
+exactly as the single-cluster node mesh proves): a tenant whose snapshot object
 changed since the last tick scatters its row through the SAME donated-patch
 path the mesh-resident single-cluster snapshot uses
 (`state/cache.py:_patch_resident`); unchanged tenants cost nothing, and the
@@ -98,20 +100,32 @@ def abstract_fleet_args(d: Dims, K: int, mesh=None):
     (tables, pending, keys, existing, _hw, _ecfg,
      _gang) = abstract_cycle_args(d)
     sh = rep = None
+    tables_sh = None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
-        from ..parallel.mesh import fleet_sharding
+        from ..parallel.mesh import fleet_sharding, fleet_shardings
 
         sh = fleet_sharding(mesh)
         rep = NamedSharding(mesh, PartitionSpec())
+        # the stacked node planes shard (TENANT_AXIS, NODE_AXIS) on a 2-D
+        # mesh; fleet_shardings is the SAME helper shard_fleet places
+        # with, so AOT input shardings cannot drift from the live stack
+        tables_sh = fleet_shardings(tables, mesh)
 
     stack = lambda t: jax.tree.map(
         lambda a: jax.ShapeDtypeStruct((K,) + a.shape, a.dtype,
                                        sharding=sh), t)
+    if tables_sh is not None:
+        stack_tables = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct((K,) + a.shape, a.dtype,
+                                              sharding=s),
+            tables, tables_sh)
+    else:
+        stack_tables = stack(tables)
     vec = lambda dt: jax.ShapeDtypeStruct((K,), dt, sharding=sh)
     scalar_f32 = jax.ShapeDtypeStruct((), jnp.float32, sharding=rep)
-    return (stack(tables), stack(pending),
+    return (stack_tables, stack(pending),
             (vec(jnp.int32), vec(jnp.int32)), stack(existing),
             vec(jnp.float32), scalar_f32,
             jax.tree.map(lambda _: scalar_f32, default_engine_config()))
@@ -130,7 +144,9 @@ class FleetStack:
     whole stack — the fleet analog of the cache's full-snapshot path."""
 
     def __init__(self, mesh=None):
-        self.mesh = mesh  # tenant-axis jax Mesh (parallel/mesh.py), or None
+        # fleet jax Mesh (parallel/mesh.py): 1-D tenant axis, 2-D
+        # tenant × node-shard, or None (single device)
+        self.mesh = mesh
         self.block = None           # (tables, pending, existing, (uk, ev))
         self.dims: Optional[Dims] = None
         self.K = 0                  # padded leading dim (the stack's K)
@@ -181,12 +197,32 @@ class FleetStack:
         self._keys_host = []
 
     def padded_k(self, live: int) -> int:
+        """K pads to the TENANT-AXIS width of the mesh (not the flat device
+        count — on a 2-D mesh each tenant row spans node-shard chips)."""
         if self.mesh is None:
             return max(live, RC_TENANT_MIN)
-        from ..parallel.mesh import padded_tenant_count
+        from ..parallel.mesh import fleet_mesh_shape, padded_tenant_count
 
-        nd = len(self.mesh.devices.flat)
-        return padded_tenant_count(max(live, RC_TENANT_MIN), nd)
+        kt, _ = fleet_mesh_shape(self.mesh)
+        return padded_tenant_count(max(live, RC_TENANT_MIN), kt)
+
+    def _node_shards(self) -> int:
+        if self.mesh is None:
+            return 1
+        from ..parallel.mesh import fleet_mesh_shape
+
+        return fleet_mesh_shape(self.mesh)[1]
+
+    def _node_pad(self, block):
+        """Pad the stacked tables' per-tenant node axis to the node-shard
+        width (2-D mesh, directly-constructed shapes only — the server
+        grows the fleet bucket so the serving path never pads here)."""
+        kn = self._node_shards()
+        if kn <= 1:
+            return block
+        from ..parallel.mesh import pad_fleet_node_tables
+
+        return (pad_fleet_node_tables(block[0], kn),) + tuple(block[1:])
 
     def refresh(self, snaps: Sequence, keys: Sequence[Tuple], d: Dims):
         """Bring the resident stack current with this tick's per-tenant
@@ -195,14 +231,19 @@ class FleetStack:
         Kp = self.padded_k(live)
         keys_host = [(int(uk), int(ev)) for uk, ev in keys]
         base = replace(d, has_node_name=False)
+        kn = self._node_shards()
+        # a bucket N that doesn't divide the node-shard row can't take the
+        # shape-stable patch path (resident rows are node-padded, staging
+        # rows are not) — restack with per-tenant inert node padding
+        n_padded = kn > 1 and int(d.N) % kn != 0
         if (self.block is None or self.dims != base or self.K != Kp
-                or self.live != live):
+                or self.live != live or n_padded):
             blocks = [(s.tables, s.pending, s.existing, k)
                       for s, k in zip(snaps, keys)]
             if Kp > live:
                 pad = empty_tenant_block(d)
                 blocks.extend([pad] * (Kp - live))
-            self.block = self._put(stack_blocks(blocks))
+            self.block = self._put(self._node_pad(stack_blocks(blocks)))
             self.dims = base
             self.K = Kp
             self.live = live

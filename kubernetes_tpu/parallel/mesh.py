@@ -40,10 +40,14 @@ from ..state.arrays import ClusterTables, NodeArrays
 NODE_AXIS = "nodes"
 
 # the FLEET axis (fleet/ subsystem): K virtual tenant clusters stacked on a
-# leading axis and split across chips — each chip owns K/n_devices whole
-# tenants, so the vmap'd fleet cycle needs NO cross-chip collectives at all
-# (tenants are independent by construction; contrast the node-axis split,
-# whose per-step argmax/psum spans every chip)
+# leading axis and split across chips. On a 1-D fleet mesh each chip owns
+# K/n_devices whole tenants, so the vmap'd fleet cycle needs NO cross-chip
+# collectives at all (tenants are independent by construction). The 2-D
+# fleet mesh (TENANT_AXIS, NODE_AXIS) additionally splits each tenant's
+# node tables across a device row — one huge tenant spreads over NODE_AXIS
+# instead of capping the fleet — and the per-step argmax/psum become
+# row-local collectives, exactly the reductions the single-cluster
+# node-axis path already proves.
 TENANT_AXIS = "tenants"
 
 XLA_MESH_HINT = (
@@ -84,6 +88,51 @@ def padded_node_count(n: int, n_devices: int) -> int:
     return ((n + n_devices - 1) // n_devices) * n_devices
 
 
+def _pad_node_arrays(nodes: NodeArrays, pad: int, axis: int = 0) -> NodeArrays:
+    """Concatenate `pad` inert node rows along `axis` — the one fill rule
+    both the single-cluster path (axis 0, the N axis) and the stacked fleet
+    path (axis 1, the per-tenant N axis inside [K, N, …]) share. Id planes
+    (int32) pad with -1 (absent — the empty_node_arrays convention);
+    count/usage planes with 0; `unschedulable` with True; everything else
+    with its dtype's zero. Every consumer is already gated on
+    `nodes.valid`, so an inert row can never admit a pod."""
+
+    def _concat(a, fill_value):
+        a = np.asarray(a)
+        shape = list(a.shape)
+        shape[axis] = pad
+        return np.concatenate(
+            [a, np.full(shape, fill_value, a.dtype)], axis=axis)
+
+    def _auto(a):
+        arr = np.asarray(a)
+        return _concat(arr, -1 if arr.dtype == np.int32 else 0)
+
+    return NodeArrays(
+        valid=_auto(nodes.valid),
+        name_id=_auto(nodes.name_id),
+        alloc=_concat(nodes.alloc, 0),
+        used=_concat(nodes.used, 0),
+        label_keys=_auto(nodes.label_keys),
+        label_vals=_auto(nodes.label_vals),
+        label_ints=_concat(nodes.label_ints, 0),
+        unschedulable=_concat(nodes.unschedulable, True),
+        taint_keys=_auto(nodes.taint_keys),
+        taint_vals=_auto(nodes.taint_vals),
+        taint_effects=_auto(nodes.taint_effects),
+        topo=_auto(nodes.topo),
+        domain=_auto(nodes.domain),
+        port_pair_any=_auto(nodes.port_pair_any),
+        port_pair_wild=_auto(nodes.port_pair_wild),
+        port_triple=_auto(nodes.port_triple),
+        img_words=_auto(nodes.img_words),
+        vol_any=_auto(nodes.vol_any),
+        vol_rw=_auto(nodes.vol_rw),
+        vol_limit=_auto(nodes.vol_limit),
+        avoid=_concat(nodes.avoid, False),
+    )
+
+
 def pad_node_tables(tables: ClusterTables, n_devices: int) -> ClusterTables:
     """Pad the node axis with inert rows (valid=False, zero capacity, every
     id -1 — the same fill as Encoder.empty_node_arrays' unoccupied slots) so
@@ -94,54 +143,8 @@ def pad_node_tables(tables: ClusterTables, n_devices: int) -> ClusterTables:
     Np = padded_node_count(N, n_devices)
     if Np == N:
         return tables
-    pad = Np - N
-
-    def _pad(a):
-        a = np.asarray(a)
-        fill = np.zeros((pad,) + a.shape[1:], a.dtype)
-        if a.dtype == np.int32:
-            # id columns pad with -1 (absent); count/usage columns with 0.
-            # -1 is the safe universal fill for an INVALID row: every
-            # consumer is already gated on nodes.valid, and -1 matches the
-            # empty_node_arrays convention for id planes
-            fill[:] = -1
-        return np.concatenate([a, fill], axis=0)
-
-    nodes = NodeArrays(
-        valid=_pad(tables.nodes.valid),
-        name_id=_pad(tables.nodes.name_id),
-        alloc=np.concatenate([np.asarray(tables.nodes.alloc),
-                              np.zeros((pad,) + np.asarray(
-                                  tables.nodes.alloc).shape[1:],
-                                  np.asarray(tables.nodes.alloc).dtype)]),
-        used=np.concatenate([np.asarray(tables.nodes.used),
-                             np.zeros((pad,) + np.asarray(
-                                 tables.nodes.used).shape[1:],
-                                 np.asarray(tables.nodes.used).dtype)]),
-        label_keys=_pad(tables.nodes.label_keys),
-        label_vals=_pad(tables.nodes.label_vals),
-        label_ints=np.concatenate([np.asarray(tables.nodes.label_ints),
-                                   np.zeros((pad,) + np.asarray(
-                                       tables.nodes.label_ints).shape[1:],
-                                       np.int32)]),
-        unschedulable=np.concatenate([np.asarray(tables.nodes.unschedulable),
-                                      np.ones((pad,), bool)]),
-        taint_keys=_pad(tables.nodes.taint_keys),
-        taint_vals=_pad(tables.nodes.taint_vals),
-        taint_effects=_pad(tables.nodes.taint_effects),
-        topo=_pad(tables.nodes.topo),
-        domain=_pad(tables.nodes.domain),
-        port_pair_any=_pad(tables.nodes.port_pair_any),
-        port_pair_wild=_pad(tables.nodes.port_pair_wild),
-        port_triple=_pad(tables.nodes.port_triple),
-        img_words=_pad(tables.nodes.img_words),
-        vol_any=_pad(tables.nodes.vol_any),
-        vol_rw=_pad(tables.nodes.vol_rw),
-        vol_limit=_pad(tables.nodes.vol_limit),
-        avoid=np.concatenate([np.asarray(tables.nodes.avoid),
-                              np.zeros((pad,), bool)]),
-    )
-    return tables._replace(nodes=nodes)
+    return tables._replace(
+        nodes=_pad_node_arrays(tables.nodes, Np - N, axis=0))
 
 
 def _node_sharded_tables_spec(tables: ClusterTables) -> ClusterTables:
@@ -197,16 +200,24 @@ def replicate(tree, mesh: Mesh):
 
 
 # ---------------------------------------------------------------------- #
-# fleet (tenant-axis) sharding — fleet/tables.py stacks K tenant clusters
-# on a leading axis; these helpers split that axis across the mesh
+# fleet (tenant × node-shard) sharding — fleet/tables.py stacks K tenant
+# clusters on a leading axis; these helpers split that axis across the
+# mesh, and (2-D mesh) additionally split each tenant's node tables
+# across a device row
 # ---------------------------------------------------------------------- #
 
 
-def make_fleet_mesh(n_devices: Optional[int] = None) -> Mesh:
-    """A 1-D mesh over the TENANT axis. Same device discipline as
-    `make_mesh` (raises with the virtual-mesh hint when short), different
-    axis name so a fleet program and a node-sharded program can never
-    accidentally share sharding annotations."""
+def make_fleet_mesh(n_devices: Optional[int] = None,
+                    node_shards: int = 1) -> Mesh:
+    """The fleet mesh. `node_shards=1` (default) is the legacy 1-D mesh
+    over the TENANT axis — each chip owns whole tenants, no collectives.
+    `node_shards=kn > 1` reshapes the same devices into a 2-D
+    `(TENANT_AXIS, NODE_AXIS)` mesh of shape (n/kn, kn): each tenant's node
+    tables split across a kn-wide device row, so one huge tenant spreads
+    over the row instead of capping the fleet. Same device discipline as
+    `make_mesh` (raises with the virtual-mesh hint when short); distinct
+    axis names keep fleet and single-cluster programs from ever sharing
+    sharding annotations by accident."""
     devs = jax.devices()
     n = n_devices or len(devs)
     if len(devs) < n:
@@ -214,7 +225,25 @@ def make_fleet_mesh(n_devices: Optional[int] = None) -> Mesh:
             f"make_fleet_mesh({n}): only {len(devs)} devices visible")
         err.__notes__ = [XLA_MESH_HINT]
         raise err
-    return Mesh(np.array(devs[:n]), (TENANT_AXIS,))
+    kn = int(node_shards or 1)
+    if kn <= 1:
+        return Mesh(np.array(devs[:n]), (TENANT_AXIS,))
+    if kn > n or n % kn:
+        raise ValueError(
+            f"make_fleet_mesh({n}, node_shards={kn}): node_shards must "
+            "divide the device count — the 2-D mesh is a (tenants, "
+            "node-shards) reshape of the same devices")
+    return Mesh(np.array(devs[:n]).reshape(n // kn, kn),
+                (TENANT_AXIS, NODE_AXIS))
+
+
+def fleet_mesh_shape(mesh: Mesh) -> Tuple[int, int]:
+    """(tenant-axis width, node-shard width) of a fleet mesh. A legacy 1-D
+    tenant mesh reads as (n, 1); the tenant width — NOT the flat device
+    count — is what K pads up to (FleetStack.padded_k)."""
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    kt = shape.get(TENANT_AXIS, len(mesh.devices.flat))
+    return int(kt), int(shape.get(NODE_AXIS, 1))
 
 
 def padded_tenant_count(k: int, n_devices: int) -> int:
@@ -224,18 +253,85 @@ def padded_tenant_count(k: int, n_devices: int) -> int:
     return padded_node_count(k, n_devices)
 
 
+def pad_fleet_node_tables(tables: ClusterTables,
+                          node_shards: int) -> ClusterTables:
+    """Pad a STACKED `[K, N, …]` ClusterTables tree so each tenant's node
+    axis (axis 1) divides `node_shards` evenly — the `pad_node_tables`
+    inert-row contract applied per tenant inside the stacked tree. The
+    serving path never needs this (FleetServer grows the fleet bucket's N
+    to a node-shard multiple before encoding), but a directly-constructed
+    stack must not crash the 2-D mesh path."""
+    N = int(tables.nodes.valid.shape[1])
+    Np = padded_node_count(N, node_shards)
+    if Np == N:
+        return tables
+    return tables._replace(
+        nodes=_pad_node_arrays(tables.nodes, Np - N, axis=1))
+
+
 def fleet_sharding(mesh: Mesh) -> NamedSharding:
-    """The one NamedSharding of the fleet layout: every stacked leaf splits
-    its leading (tenant) axis; later axes stay unsharded."""
+    """The base NamedSharding of the fleet layout: a stacked leaf splits
+    its leading (tenant) axis; later axes stay unsharded (on a 2-D mesh
+    that means replicated across the node-shard row). Node planes of the
+    stacked ClusterTables get the 2-D spec instead — see `fleet_specs`."""
     return NamedSharding(mesh, P(TENANT_AXIS))
 
 
+def fleet_specs(tree, mesh: Mesh):
+    """PartitionSpec pytree for a stacked fleet tree (every leaf [K, …]).
+    Mirrors `_node_sharded_tables_spec` one axis up: on a 2-D mesh the
+    stacked NodeArrays planes ([K, N, …]) shard (TENANT_AXIS, NODE_AXIS) —
+    each tenant's nodes split across its device row — while every other
+    leaf (class/term/req tables, pending/existing pods, keys, quotas)
+    shards the tenant axis only, i.e. replicates across the row, because
+    the per-step argmax over N reads every pod row on every row chip.
+    On a 1-D mesh this degenerates to P(TENANT_AXIS) everywhere."""
+    _, kn = fleet_mesh_shape(mesh)
+    node_p = P(TENANT_AXIS, NODE_AXIS) if kn > 1 else P(TENANT_AXIS)
+    tenant_p = P(TENANT_AXIS)
+
+    def _specs(sub):
+        if isinstance(sub, ClusterTables):
+            return ClusterTables(
+                nodes=type(sub.nodes)(*[node_p for _ in sub.nodes]),
+                reqs=type(sub.reqs)(*[tenant_p for _ in sub.reqs]),
+                labelsets=type(sub.labelsets)(
+                    *[tenant_p for _ in sub.labelsets]),
+                nterms=type(sub.nterms)(*[tenant_p for _ in sub.nterms]),
+                tolsets=type(sub.tolsets)(*[tenant_p for _ in sub.tolsets]),
+                portsets=type(sub.portsets)(
+                    *[tenant_p for _ in sub.portsets]),
+                terms=type(sub.terms)(*[tenant_p for _ in sub.terms]),
+                classes=type(sub.classes)(*[tenant_p for _ in sub.classes]),
+                images=type(sub.images)(*[tenant_p for _ in sub.images]),
+                zone_keys=tenant_p,
+                volsets=type(sub.volsets)(*[tenant_p for _ in sub.volsets]),
+                drv_masks=tenant_p,
+            )
+        return jax.tree.map(lambda _: tenant_p, sub)
+
+    return jax.tree.map(_specs, tree,
+                        is_leaf=lambda x: isinstance(x, ClusterTables))
+
+
+def fleet_shardings(tree, mesh: Mesh):
+    """NamedSharding pytree matching `shard_fleet`'s placement — shared by
+    the live placement path (fleet/tables.py FleetStack) and the AOT
+    prewarm path (abstract_fleet_args), so compiled input shardings can
+    never drift from what the server actually places."""
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        fleet_specs(tree, mesh),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
 def shard_fleet(tree, mesh: Mesh):
-    """Place a stacked fleet pytree (every leaf [K, …]) on the mesh, tenant
-    axis split. K must already be a multiple of the mesh size — the fleet
-    stack pads with inert tenants first (fleet/tables.py)."""
-    sh = fleet_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+    """Place a stacked fleet pytree (every leaf [K, …]) on the mesh: tenant
+    axis split, and on a 2-D mesh each tenant's node planes additionally
+    split across the node-shard row. K must already be a multiple of the
+    tenant-axis width — the fleet stack pads with inert tenants first
+    (fleet/tables.py) — and stacked node axes must divide the node-shard
+    width (`pad_fleet_node_tables` when constructed directly)."""
+    return jax.tree.map(jax.device_put, tree, fleet_shardings(tree, mesh))
 
 
 class MeshState:
@@ -258,20 +354,36 @@ class MeshState:
 
     Device counts stay powers of two so the bucketed node axis (state/dims.py
     grown_for keeps N pow2-friendly) divides evenly without padding in the
-    steady state; `shard_tables` pads when a raw shape doesn't."""
+    steady state; `shard_tables` pads when a raw shape doesn't.
 
-    def __init__(self, n_devices: Optional[int] = None):
+    Fleet mode (`fleet_node_shards` not None): meshes are built with
+    `make_fleet_mesh` instead — 1-D tenant mesh when node_shards is 1, the
+    2-D (TENANT_AXIS, NODE_AXIS) mesh otherwise — so degrade/reform under
+    the 2-D signature rides the exact same ladder: a loss drops the whole
+    mesh, reform rebuilds (narrower after an unproven loss) with the
+    node-shard width clamped to the reformed device count. Both widths are
+    powers of two, so the clamp always divides."""
+
+    def __init__(self, n_devices: Optional[int] = None,
+                 fleet_node_shards: Optional[int] = None):
         self._mu = threading.Lock()
         self._requested = n_devices
         self._lost_width: Optional[int] = None
+        self._fleet_ns = fleet_node_shards
         self.reforms = 0
         self.demotions = 0
         m = None
         avail = len(jax.devices())
         want = n_devices or avail
         if want > 1 and avail >= 2:
-            m = make_mesh(self._pow2_floor(min(want, avail)))
+            m = self._build(self._pow2_floor(min(want, avail)))
         self.mesh: Optional[Mesh] = m
+
+    def _build(self, width: int) -> Mesh:
+        if self._fleet_ns is None:
+            return make_mesh(width)
+        ns = self._pow2_floor(max(int(self._fleet_ns), 1))
+        return make_fleet_mesh(width, node_shards=min(ns, width))
 
     @staticmethod
     def _pow2_floor(n: int) -> int:
@@ -307,7 +419,7 @@ class MeshState:
             if want <= 1:
                 self.mesh = None
                 return None
-            self.mesh = make_mesh(want)
+            self.mesh = self._build(want)
             if full:
                 self._lost_width = None
             self.reforms += 1

@@ -298,8 +298,15 @@ class Scheduler:
         if isinstance(mesh, MeshState):
             return mesh
         if isinstance(mesh, str):
-            n = None if mesh == "auto" else int(mesh)
-            return MeshState(n)
+            # bounds-checked: KTPU_MESH=garbage must degrade to single-
+            # device serving, never crash Scheduler() at import-of-config
+            # time (clamped 0 sentinel → no mesh, same as unset)
+            from ..utils.envparse import clamped_int
+
+            if mesh == "auto":
+                return MeshState(None)
+            n = clamped_int(mesh, 0, 0, 4096)
+            return MeshState(n) if n > 1 else None
         if isinstance(mesh, int):
             return MeshState(mesh) if mesh > 1 else None
         # a raw jax.sharding.Mesh: adopt it as the live mesh

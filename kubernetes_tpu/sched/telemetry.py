@@ -37,6 +37,7 @@ untelemetered flagship pods/s).
 
 from __future__ import annotations
 
+import gzip
 import json
 import os
 import threading
@@ -57,6 +58,77 @@ DUMP_TRIGGERS = ("abandoned", "watchdog_timeout", "storm", "breaker_open",
 #: ticks add stack-refresh/solo phases) — tests assert ordering against it
 WAVE_PHASES = ("pump", "pop", "snapshot", "prewarm", "dispatch", "readback",
                "intent-write", "bind-commit", "retire", "requeue")
+
+#: per-record payload caps, applied at SERIALIZATION time (snapshot/dump —
+#: the in-memory ring keeps full records): a large fleet's per-tick tenant
+#: map and a storm's event burst were most of FLIGHT_rNN.json's ~4.6k
+#: lines per bench run. Overridable via KTPU_FLIGHT_FLEET_CAP /
+#: KTPU_FLIGHT_EVENT_CAP (bounds-checked; garbage → default).
+FLIGHT_FLEET_TENANT_CAP = 8
+FLIGHT_EVENT_CAP = 32
+
+
+def _cap_record(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """A serialization-bounded copy of one wave record: the fleet map keeps
+    the `tenant cap` busiest tenants (by attempted, ties by name) plus one
+    aggregate "..." row summing every numeric field of the omitted rest —
+    fleet-wide totals stay reconstructable from the capped form; the
+    supervisor-event list keeps its head and tail around an explicit
+    truncation marker. Records already under the caps pass through
+    unchanged (same content, fresh dict)."""
+    from ..utils.envparse import env_int
+
+    out = dict(rec)
+    fleet = out.get("fleet")
+    tcap = env_int("KTPU_FLIGHT_FLEET_CAP", FLIGHT_FLEET_TENANT_CAP,
+                   1, 4096)
+    if isinstance(fleet, dict) and len(fleet) > tcap:
+        busiest = sorted(
+            fleet, key=lambda n: (-(fleet[n].get("attempted", 0)
+                                    if isinstance(fleet[n], dict) else 0),
+                                  str(n)))
+        keep = set(busiest[:tcap])
+        agg: Dict[str, Any] = {"tenants_omitted": len(fleet) - len(keep)}
+        for n, v in fleet.items():
+            if n in keep or not isinstance(v, dict):
+                continue
+            for k2, x in v.items():
+                if isinstance(x, (int, float)):
+                    agg[k2] = agg.get(k2, 0) + x
+        capped = {n: v for n, v in fleet.items() if n in keep}
+        capped["..."] = agg
+        out["fleet"] = capped
+    ev = out.get("supervisor_events")
+    ecap = env_int("KTPU_FLIGHT_EVENT_CAP", FLIGHT_EVENT_CAP, 1, 4096)
+    if isinstance(ev, list) and len(ev) > ecap:
+        head = ev[:max(ecap // 2, 1)]
+        tail = ev[len(ev) - max(ecap - len(head) - 1, 0):]
+        out["supervisor_events"] = (
+            head
+            + [("truncated",
+                f"{len(ev) - len(head) - len(tail)} events omitted")]
+            + tail)
+    return out
+
+
+def _write_dump(doc: Dict[str, Any], path: str) -> None:
+    """Write a flight document compactly: one JSON line per wave record
+    instead of `indent=1`'s line-per-scalar (which made FLIGHT_rNN.json
+    ~4.6k lines per bench run). Still a single valid JSON object —
+    `json.load` reconstructs it unchanged. A `.gz` path gzips the same
+    bytes (KTPU_FLIGHT_GZIP policy appends the suffix)."""
+    opener = (lambda p: gzip.open(p, "wt")) if path.endswith(".gz") else \
+        (lambda p: open(p, "w"))
+    with opener(path) as f:
+        f.write("{\n")
+        for k, v in doc.items():
+            if k == "records":
+                continue
+            f.write(f" {json.dumps(k)}: {json.dumps(v)},\n")
+        recs = doc.get("records", [])
+        f.write(' "records": [\n')
+        f.write(",\n".join("  " + json.dumps(r) for r in recs))
+        f.write("\n ]\n}\n" if recs else " ]\n}\n")
 
 
 class PodLatencyTracker:
@@ -138,7 +210,7 @@ class FlightRecorder:
                 "capacity": self.capacity,
                 "evicted": self.evicted,
                 "last_seq": self._seq,
-                "records": [dict(r) for r in self._ring],
+                "records": [_cap_record(r) for r in self._ring],
             }
 
 
@@ -419,14 +491,17 @@ class SchedulerTelemetry:
         if path is None:
             flight_dir = os.environ.get("KTPU_FLIGHT_DIR")
             if flight_dir:
+                # KTPU_FLIGHT_GZIP: gzip auto-dumped artifacts (the bloat
+                # knob for long soak runs; explicit `path` callers opt in
+                # by passing a .gz path themselves)
+                suffix = ".json.gz" if os.environ.get(
+                    "KTPU_FLIGHT_GZIP", "") not in ("", "0") else ".json"
                 path = os.path.join(
                     flight_dir,
-                    f"flight-{self.name}-{trigger}-{doc['last_seq']}.json")
+                    f"flight-{self.name}-{trigger}-{doc['last_seq']}{suffix}")
         if path:
             try:
-                with open(path, "w") as f:
-                    json.dump(doc, f, indent=1)
-                    f.write("\n")
+                _write_dump(doc, path)
             except OSError:
                 pass  # a full disk must never take down the serving loop
         return doc

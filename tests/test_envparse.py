@@ -1,0 +1,91 @@
+"""Bounds-checked env parsing (utils/envparse.py, ISSUE 20 satellite).
+
+Every integer knob the scheduler or bench reads from the environment
+(KTPU_FLEET_TENANTS, KTPU_MESH, KTPU_FLEET_NODE_SHARDS, bench shape
+overrides) routes through one clamp helper: garbage falls back to the
+default, out-of-range values clamp, and nothing ever crashes `int()`.
+"""
+
+import pytest
+
+from kubernetes_tpu.utils.envparse import clamped_int, env_int, env_opt_int
+
+
+class TestClampedInt:
+    def test_passthrough_in_range(self):
+        assert clamped_int("7", 1, 0, 100) == 7
+        assert clamped_int(7, 1, 0, 100) == 7
+
+    def test_strips_whitespace(self):
+        assert clamped_int("  42\n", 1, 0, 100) == 42
+
+    @pytest.mark.parametrize("garbage", [None, "", "lots", "1.5", "0x10",
+                                         "1e3", object()])
+    def test_garbage_falls_back_to_default(self, garbage):
+        assert clamped_int(garbage, 16, 1, 1024) == 16
+
+    def test_clamps_low_and_high(self):
+        assert clamped_int("-5", 16, 1, 1024) == 1
+        assert clamped_int("999999", 16, 1, 1024) == 1024
+
+    def test_default_itself_is_clamped(self):
+        # a caller bug (default outside the range) still yields a sane value
+        assert clamped_int("junk", 0, 1, 8) == 1
+
+    def test_negative_range(self):
+        assert clamped_int("-3", 0, -10, 10) == -3
+
+
+class TestEnvInt:
+    def test_unset_is_default(self, monkeypatch):
+        monkeypatch.delenv("KTPU_TEST_KNOB", raising=False)
+        assert env_int("KTPU_TEST_KNOB", 24, 1, 1024) == 24
+
+    def test_set_parses_and_clamps(self, monkeypatch):
+        monkeypatch.setenv("KTPU_TEST_KNOB", "32")
+        assert env_int("KTPU_TEST_KNOB", 24, 1, 1024) == 32
+        monkeypatch.setenv("KTPU_TEST_KNOB", "100000")
+        assert env_int("KTPU_TEST_KNOB", 24, 1, 1024) == 1024
+
+    def test_garbage_is_default(self, monkeypatch):
+        monkeypatch.setenv("KTPU_TEST_KNOB", "lots")
+        assert env_int("KTPU_TEST_KNOB", 24, 1, 1024) == 24
+
+
+class TestEnvOptInt:
+    def test_unset_or_blank_is_none(self, monkeypatch):
+        monkeypatch.delenv("KTPU_TEST_KNOB", raising=False)
+        assert env_opt_int("KTPU_TEST_KNOB", 0, 4096) is None
+        monkeypatch.setenv("KTPU_TEST_KNOB", "   ")
+        assert env_opt_int("KTPU_TEST_KNOB", 0, 4096) is None
+
+    def test_garbage_is_none_not_crash(self, monkeypatch):
+        monkeypatch.setenv("KTPU_TEST_KNOB", "auto")
+        assert env_opt_int("KTPU_TEST_KNOB", 0, 4096) is None
+
+    def test_numeric_clamps(self, monkeypatch):
+        monkeypatch.setenv("KTPU_TEST_KNOB", "8")
+        assert env_opt_int("KTPU_TEST_KNOB", 0, 4096) == 8
+        monkeypatch.setenv("KTPU_TEST_KNOB", "99999")
+        assert env_opt_int("KTPU_TEST_KNOB", 0, 4096) == 4096
+
+
+class TestSchedulerMeshKnob:
+    """KTPU_MESH=garbage must mean single-device serving, not a crash."""
+
+    def test_garbage_mesh_string(self):
+        from kubernetes_tpu.sched.scheduler import Scheduler
+
+        assert Scheduler._make_mesh_state("lots") is None
+
+    def test_zero_and_one_mean_no_mesh(self):
+        from kubernetes_tpu.sched.scheduler import Scheduler
+
+        assert Scheduler._make_mesh_state("0") is None
+        assert Scheduler._make_mesh_state("1") is None
+
+    def test_fleet_server_mesh_garbage(self):
+        from kubernetes_tpu.fleet.server import FleetServer
+
+        mesh, state = FleetServer._make_fleet_mesh("lots")
+        assert mesh is None and state is None

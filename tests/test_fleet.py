@@ -63,9 +63,10 @@ def det_server(**kw):
     return srv
 
 
-def build_fleet(spec, batch_size=16, mesh=None, storage=None):
-    """spec: [(name, n_nodes, n_pods, quota)] → (server, {name: binder})."""
-    srv = det_server(batch_size=batch_size, mesh=mesh, storage=storage)
+def build_fleet(spec, batch_size=16, mesh=None, storage=None, **kw):
+    """spec: [(name, n_nodes, n_pods, quota)] → (server, {name: binder}).
+    Extra kwargs (node_shards, engines, base_dims, …) pass to FleetServer."""
+    srv = det_server(batch_size=batch_size, mesh=mesh, storage=storage, **kw)
     binders = {}
     for name, n_nodes, n_pods, quota in spec:
         b = RecordingBinder()
@@ -527,6 +528,202 @@ class TestGangTenant:
         for tn in srv.tenants.values():
             assert tn.sched.queue.lengths()[0] == 0
         for name in ("gang", "plain"):
+            keys = [k for k, _ in binders[name].bound]
+            assert len(keys) == len(set(keys))
+
+
+@pytest.mark.mesh
+class TestFleet2DMesh:
+    """ISSUE 20 tentpole: the (tenant × node-shard) 2-D fleet mesh."""
+
+    SPEC = [("a", 5, 7, 1.0), ("b", 3, 5, 1.0), ("c", 6, 9, 1.0)]
+
+    def _run(self, mesh, node_shards=None, engines=None, spec=None):
+        srv, binders = build_fleet(
+            spec or self.SPEC, mesh=mesh,
+            **({} if node_shards is None else {"node_shards": node_shards}),
+            **({} if engines is None else {"engines": engines}))
+        srv.run_until_idle(max_ticks=8)
+        return srv, binders
+
+    def test_make_fleet_mesh_shapes(self):
+        import jax
+
+        from kubernetes_tpu.parallel.mesh import (
+            NODE_AXIS, TENANT_AXIS, fleet_mesh_shape, make_fleet_mesh)
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 (virtual) devices")
+        m1 = make_fleet_mesh(8)
+        assert m1.axis_names == (TENANT_AXIS,)
+        assert fleet_mesh_shape(m1) == (8, 1)
+        m2 = make_fleet_mesh(8, node_shards=2)
+        assert m2.axis_names == (TENANT_AXIS, NODE_AXIS)
+        assert fleet_mesh_shape(m2) == (4, 2)
+        with pytest.raises(ValueError):
+            make_fleet_mesh(8, node_shards=3)   # must divide the width
+
+    def test_pad_fleet_node_rows_are_inert(self):
+        """Non-divisible N on the stacked [K, N, …] tree: every padded
+        node row carries the pad_node_tables inert contract — invalid,
+        unschedulable, name -1, zero capacity — per tenant."""
+        from kubernetes_tpu.parallel.mesh import pad_fleet_node_tables
+
+        d = Dims().grown_for(N=8, P=8, E=8)
+        stacked = stack_blocks([empty_tenant_block(d) for _ in range(3)])
+        tables = stacked[0]
+        # carve N down to a non-divisible 6, then pad back for 4 shards
+        import jax
+
+        tables6 = jax.tree.map(
+            lambda a: a[:, :6] if a.ndim >= 2 and a.shape[1] == d.N else a,
+            tables)
+        padded = pad_fleet_node_tables(tables6, 4)
+        n = padded.nodes
+        assert n.valid.shape[:2] == (3, 8)
+        assert not bool(n.valid[:, 6:].any())
+        assert bool(n.unschedulable[:, 6:].all())
+        assert int(n.name_id[:, 6:].max()) == -1
+        assert float(abs(n.alloc[:, 6:]).sum()) == 0.0
+        assert float(abs(n.used[:, 6:]).sum()) == 0.0
+        assert not bool(n.avoid[:, 6:].any())
+
+    def test_2d_bit_equal_vs_1d_and_single_device(self):
+        """K=3 tenants (pad tenant on the 4-wide tenant axis) with ragged
+        per-tenant node counts on the 2-D mesh: placements bit-equal to
+        the 1-D tenant mesh AND to the meshless run — zero phantom
+        admissions onto pad tenants or pad node rows, one dispatch per
+        tick throughout."""
+        import jax
+
+        from kubernetes_tpu.parallel.mesh import fleet_mesh_shape
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 (virtual) devices")
+        srv2, b2 = self._run(mesh=8, node_shards=2)
+        assert fleet_mesh_shape(srv2.mesh) == (4, 2)
+        assert srv2.stack.K == 4              # 3 tenants + 1 pad tenant
+        assert srv2.max_dispatches_per_tick == 1
+        srv1, b1 = self._run(mesh=8)
+        assert fleet_mesh_shape(srv1.mesh) == (8, 1)
+        srv0, b0 = self._run(mesh=None)
+        for name, n_nodes, n_pods, _ in self.SPEC:
+            assert sorted(b2[name].bound) == sorted(b1[name].bound), name
+            assert sorted(b2[name].bound) == sorted(b0[name].bound), name
+            # every pod landed exactly once, on a REAL node of its own
+            # tenant (a phantom admission would surface a pad row's -1
+            # name or drop a pod)
+            keys = [k for k, _ in b2[name].bound]
+            assert len(keys) == n_pods and len(set(keys)) == n_pods
+            real = {f"n{i}" for i in range(n_nodes)}
+            assert {nn for _, nn in b2[name].bound} <= real
+
+    def test_refresh_pads_nondivisible_k_and_n_together(self):
+        """Direct-constructed dims whose N the node axis does not divide,
+        AND a live K under the tenant width: refresh stacks inert pad
+        TENANTS and inert pad NODE rows simultaneously, and keeps forcing
+        the full restack (the patch path would scatter unpadded staging
+        rows onto node-padded residents)."""
+        import jax
+
+        from dataclasses import replace as _replace
+
+        from kubernetes_tpu.parallel.mesh import make_fleet_mesh
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 (virtual) devices")
+        from types import SimpleNamespace
+
+        mesh = make_fleet_mesh(8, node_shards=4)   # tenant width 2
+        stack = FleetStack(mesh=mesh)
+        d = _replace(Dims(), N=6, P=8, E=8)        # 6 % 4 != 0
+        blk = empty_tenant_block(d)
+        snaps = [SimpleNamespace(tables=blk[0], pending=blk[1],
+                                 existing=blk[2])]  # K=1 < width 2
+        kp = stack.refresh(snaps, [(0, 0)], d)
+        assert kp == 2
+        tables = stack.block[0]
+        assert tables.nodes.valid.shape[:2] == (2, 8)   # K and N padded
+        assert not bool(tables.nodes.valid.any())       # all rows inert
+        restacks = stack.full_restacks
+        stack.refresh(snaps, [(0, 0)], d)
+        assert stack.full_restacks == restacks + 1      # patch path barred
+
+    def test_mixed_engines_one_dispatch_per_group(self):
+        """Per-tenant engines split the tick into engine groups: exactly
+        one dispatch per group per tick, placements bit-equal to each
+        tenant's SOLO run under its own engine."""
+        engines = {"a": "waves", "b": "runs", "c": "scan"}
+        srv, bm = self._run(mesh=None, engines=engines)
+        total = srv.run_until_idle(max_ticks=2)  # idle: no extra groups
+        assert set(srv.stacks) <= {"waves", "runs", "scan"}
+        assert srv.max_engine_groups == 3
+        assert srv.max_dispatches_per_tick == 3
+        del total
+        for name, n_nodes, n_pods, quota in self.SPEC:
+            _, solo = self._run(mesh=None,
+                                engines={name: engines[name]},
+                                spec=[(name, n_nodes, n_pods, quota)])
+            assert sorted(bm[name].bound) == sorted(solo[name].bound), name
+
+    def test_mixed_engines_on_2d_mesh_bit_equal(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 (virtual) devices")
+        engines = {"a": "waves", "b": "runs", "c": "scan"}
+        srv2, b2 = self._run(mesh=8, node_shards=2, engines=engines)
+        assert srv2.max_engine_groups == 3
+        srv0, b0 = self._run(mesh=None, engines=engines)
+        for name, _, _, _ in self.SPEC:
+            assert sorted(b2[name].bound) == sorted(b0[name].bound), name
+
+    def test_bad_engine_name_rejected(self):
+        with pytest.raises(ValueError, match="engine"):
+            FleetServer(engines={"a": "warp"})
+
+    @pytest.mark.chaos
+    def test_degrade_reform_under_2d_signature(self, monkeypatch):
+        """TestDegradedBackend's drill on the 2-D mesh: backend loss drops
+        the fleet mesh (degraded ticks serve via fallback, resident stack
+        untouched), re-admission REFORMS the (tenant × node-shard) mesh —
+        same 2-D signature — and the next ticks restack and drain with
+        nothing lost or double-bound."""
+        import jax
+
+        from kubernetes_tpu.parallel.mesh import fleet_mesh_shape
+        from kubernetes_tpu.utils import faultline
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 (virtual) devices")
+        monkeypatch.setenv("KTPU_PROBE_BACKOFF", "0.05")
+        srv, binders = build_fleet([("a", 2, 4, 1.0), ("b", 2, 4, 1.0)],
+                                   mesh=8, node_shards=2)
+        assert fleet_mesh_shape(srv.mesh) == (4, 2)
+        srv.tick()
+        assert srv.stack.block is not None
+        faultline.install("device.error@probe:1+")   # pin re-admission off
+        try:
+            srv.supervisor._mark_unhealthy("injected backend loss")
+            assert srv.mesh_state.mesh is None       # dropped, not narrowed
+            feed(srv.tenant("a"), "a2", 3)
+            tk = srv.tick()                          # degraded, fallback
+            assert srv.mesh is None                  # adopted the drop
+            assert tk.per_tenant["a"].scheduled >= 1
+        finally:
+            faultline.uninstall()
+        srv.supervisor._readmit()
+        prober = srv.supervisor._prober
+        if prober is not None:
+            prober.join(timeout=10)
+        feed(srv.tenant("b"), "b2", 2)
+        srv.run_until_idle(max_ticks=4)
+        # the reformed mesh is 2-D again and the server adopted it
+        assert srv.mesh is srv.mesh_state.mesh
+        assert fleet_mesh_shape(srv.mesh) == (4, 2)
+        assert len(binders["a"].bound) == 7
+        assert len(binders["b"].bound) == 6
+        for name in ("a", "b"):
             keys = [k for k, _ in binders[name].bound]
             assert len(keys) == len(set(keys))
 

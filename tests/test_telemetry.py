@@ -277,6 +277,88 @@ class TestDumpOnAbandon:
         assert on_disk["records"]
 
 
+class TestFlightArtifactCaps:
+    """ISSUE 20 satellite: FLIGHT_rNN.json bloat — per-record payload caps
+    at serialization time plus the record-per-line (optionally gzipped)
+    dump format. The in-memory ring keeps full records."""
+
+    def test_fleet_map_caps_to_busiest_with_aggregate(self):
+        from kubernetes_tpu.sched.telemetry import _cap_record
+
+        rec = {"fleet": {f"t{i:02d}": {"attempted": i, "scheduled": i}
+                         for i in range(12)}}
+        out = _cap_record(rec)
+        assert len(out["fleet"]) == 9          # 8 busiest + "..."
+        agg = out["fleet"]["..."]
+        assert agg["tenants_omitted"] == 4
+        # busiest by attempted kept (t11..t04); the quiet tail aggregates
+        assert agg["attempted"] == 0 + 1 + 2 + 3
+        assert "t11" in out["fleet"] and "t00" not in out["fleet"]
+        assert len(rec["fleet"]) == 12         # source record untouched
+
+    def test_event_list_caps_head_and_tail_around_marker(self):
+        from kubernetes_tpu.sched.telemetry import _cap_record
+
+        ev = [(f"k{i}", "d") for i in range(100)]
+        out = _cap_record({"supervisor_events": ev})
+        capped = out["supervisor_events"]
+        assert len(capped) == 32
+        assert capped[0] == ("k0", "d") and capped[-1] == ("k99", "d")
+        marker = capped[16]
+        assert marker[0] == "truncated" and "omitted" in marker[1]
+
+    def test_under_cap_records_pass_through_unchanged(self):
+        from kubernetes_tpu.sched.telemetry import _cap_record
+
+        rec = {"fleet": {"t00": {"attempted": 3}},
+               "supervisor_events": [("storm", "t00")], "rc": 1}
+        assert _cap_record(rec) == rec
+
+    def test_caps_are_env_tunable_and_clamped(self, monkeypatch):
+        from kubernetes_tpu.sched.telemetry import _cap_record
+
+        monkeypatch.setenv("KTPU_FLIGHT_FLEET_CAP", "2")
+        rec = {"fleet": {f"t{i}": {"attempted": i} for i in range(5)}}
+        assert len(_cap_record(rec)["fleet"]) == 3   # 2 + "..."
+        monkeypatch.setenv("KTPU_FLIGHT_FLEET_CAP", "garbage")
+        assert len(_cap_record(rec)["fleet"]) == 5   # default cap 8: all
+
+    def test_dump_is_record_per_line_and_reconstructable(self, tmp_path):
+        clk = {"t": 0.0}
+        s = _scheduler(clk)
+        for i in range(5):
+            s.on_pod_add(_pod(i))
+            s.schedule_pending()
+        path = tmp_path / "flight.json"
+        doc = s.telemetry.dump("manual", path=str(path))
+        text = path.read_text()
+        on_disk = json.loads(text)                   # still ONE json object
+        assert on_disk["last_seq"] == doc["last_seq"]
+        assert len(on_disk["records"]) == len(doc["records"])
+        # the bloat fix itself: one line per record, not one per scalar
+        rec_lines = [ln for ln in text.splitlines() if ln.startswith("  ")]
+        assert len(rec_lines) == len(on_disk["records"])
+        assert len(text.splitlines()) <= len(on_disk["records"]) + 16
+
+    def test_gzip_policy_for_flight_dir_dumps(self, tmp_path, monkeypatch):
+        import gzip as _gzip
+        import os as _os
+
+        monkeypatch.setenv("KTPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("KTPU_FLIGHT_GZIP", "1")
+        clk = {"t": 0.0}
+        s = _scheduler(clk)
+        s.on_pod_add(_pod(0))
+        s.schedule_pending()
+        doc = s.telemetry.dump("manual")
+        files = [f for f in _os.listdir(tmp_path) if f.endswith(".json.gz")]
+        assert len(files) == 1
+        with _gzip.open(tmp_path / files[0], "rt") as f:
+            on_disk = json.load(f)
+        assert on_disk["last_seq"] == doc["last_seq"]
+        assert on_disk["records"]
+
+
 @pytest.mark.chaos
 @pytest.mark.fleet
 class TestFleetStormDump:
